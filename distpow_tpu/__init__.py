@@ -1,0 +1,18 @@
+"""distpow_tpu — a TPU-native distributed proof-of-work framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+Go system ``philipjesic/Distributed-Proof-Of-Work`` (mounted read-only at
+/root/reference; see SURVEY.md for the structural analysis this build
+follows).  Layering:
+
+* ``models``   — puzzle semantics and pluggable hash models (MD5, SHA-256)
+* ``ops``      — device ops: candidate packing, difficulty masks, fused
+                 search step, Pallas kernel
+* ``parallel`` — partition algebra, batched drivers, mesh (multi-chip) search
+* ``runtime``  — RPC transport, distributed tracing, dominance cache, config
+* ``backends`` — worker compute backends (python / jax / mesh / native C++)
+* ``nodes``    — client library (powlib), client, coordinator, worker
+* ``cli``      — process entry points mirroring the reference's cmd/ tree
+"""
+
+__version__ = "0.1.0"

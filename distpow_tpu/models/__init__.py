@@ -1,0 +1,4 @@
+from . import puzzle
+from .registry import MD5, SHA256, HashModel, get_hash_model, register_hash_model
+
+__all__ = ["puzzle", "MD5", "SHA256", "HashModel", "get_hash_model", "register_hash_model"]

@@ -1,0 +1,172 @@
+"""Core proof-of-work puzzle semantics (pure Python, no JAX).
+
+This module pins the behavioral contract of the reference system before any
+performance work happens.  The contract (reference: worker.go:353-356,
+worker.go:246-256):
+
+    given ``nonce: bytes`` and ``num_trailing_zeros: int``, find
+    ``secret: bytes`` such that the lowercase hex encoding of
+    ``md5(nonce + secret)`` ends in at least ``num_trailing_zeros``
+    ASCII ``'0'`` characters.
+
+Notes on units: the difficulty counts trailing zero *hex digits* (nibbles,
+4 bits each) of the digest, not bits.  A 16-byte MD5 digest has 32 nibbles,
+so difficulties above 32 are unsatisfiable.
+
+The secret search-space enumeration contract (reference: worker.go:234-244,
+worker.go:301-319):
+
+    secret = bytes([thread_byte]) + chunk
+
+where ``chunk`` starts empty and advances via an append-carry counter
+(``next_chunk``), and for each chunk value all of the worker's thread bytes
+are tried in ascending order before the chunk advances.  The chunk counter
+enumerates exactly the *minimal little-endian byte encodings* of the
+integers 0, 1, 2, ... (0 is the empty chunk; value n >= 1 has
+``ceil(bit_length(n)/8)`` bytes with a non-zero top byte).  This integer
+<-> chunk bijection is what lets the TPU backend map a flat batch index to
+a candidate arithmetically, with one kernel launch per chunk width.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Iterator, Optional, Sequence, Tuple
+
+# An MD5 digest has 16 bytes = 32 hex nibbles.
+MAX_DIFFICULTY_MD5 = 32
+
+
+def hash_hex(nonce: bytes, secret: bytes, algo: str = "md5") -> str:
+    """Lowercase hex digest of ``algo(nonce + secret)`` (worker.go:353-355)."""
+    h = hashlib.new(algo)
+    h.update(bytes(nonce) + bytes(secret))
+    return h.hexdigest()
+
+
+def count_trailing_zero_chars(s: str) -> int:
+    """Number of trailing ``'0'`` characters of ``s`` (worker.go:246-256)."""
+    n = 0
+    for ch in reversed(s):
+        if ch == "0":
+            n += 1
+        else:
+            break
+    return n
+
+
+def count_trailing_zero_nibbles(digest: bytes) -> int:
+    """Trailing zero nibbles of a raw digest.
+
+    Equivalent to ``count_trailing_zero_chars(digest.hex())``: the hex string
+    is written most-significant-nibble first per byte, so trailing characters
+    are (low nibble of last byte, high nibble of last byte, low nibble of the
+    second-to-last byte, ...).
+    """
+    n = 0
+    for b in reversed(digest):
+        if b == 0:
+            n += 2
+            continue
+        if b & 0x0F == 0:
+            n += 1
+        break
+    return n
+
+
+def check_secret(
+    nonce: bytes, secret: bytes, num_trailing_zeros: int, algo: str = "md5"
+) -> bool:
+    """True iff ``secret`` solves the puzzle (worker.go:353-356)."""
+    h = hashlib.new(algo)
+    h.update(bytes(nonce) + bytes(secret))
+    return count_trailing_zero_nibbles(h.digest()) >= num_trailing_zeros
+
+
+def next_chunk(chunk: bytearray) -> bytearray:
+    """Advance the append-carry chunk counter in place (worker.go:234-244).
+
+    Increments byte 0; a 0xFF byte wraps to 0 and carries into the next byte;
+    if every byte wraps, a fresh ``1`` byte is appended (so ``[] -> [1]`` and
+    ``[0xFF, 0xFF] -> [0, 0, 1]``).
+    """
+    for i in range(len(chunk)):
+        if chunk[i] == 0xFF:
+            chunk[i] = 0
+        else:
+            chunk[i] += 1
+            return chunk
+    chunk.append(1)
+    return chunk
+
+
+def chunk_to_int(chunk: bytes) -> int:
+    """Little-endian integer value of a chunk."""
+    return int.from_bytes(chunk, "little")
+
+
+def int_to_chunk(n: int) -> bytes:
+    """Minimal little-endian encoding of ``n`` (inverse of the counter walk).
+
+    ``0`` maps to the empty chunk; otherwise the top byte is non-zero.
+    """
+    if n == 0:
+        return b""
+    return n.to_bytes((n.bit_length() + 7) // 8, "little")
+
+
+def chunk_width(n: int) -> int:
+    """Byte width of the chunk encoding ``int_to_chunk(n)``."""
+    return 0 if n == 0 else (n.bit_length() + 7) // 8
+
+
+def iter_candidates(
+    thread_bytes: Sequence[int], start: int = 0
+) -> Iterator[Tuple[int, int, bytes]]:
+    """Yield ``(chunk_int, thread_byte, secret)`` in reference enumeration
+    order: for each chunk value all thread bytes are tried before the chunk
+    advances (worker.go:318-399).  ``start`` is the first chunk integer.
+    """
+    n = start
+    while True:
+        chunk = int_to_chunk(n)
+        for tb in thread_bytes:
+            yield n, tb, bytes([tb]) + chunk
+        n += 1
+
+
+def python_search(
+    nonce: bytes,
+    num_trailing_zeros: int,
+    thread_bytes: Sequence[int],
+    algo: str = "md5",
+    start_chunk: int = 0,
+    max_candidates: Optional[int] = None,
+    cancel_check: Optional[Callable[[], bool]] = None,
+    cancel_poll_interval: int = 4096,
+) -> Optional[bytes]:
+    """Reference-order brute force over ``iter_candidates`` using hashlib.
+
+    This is the behavioral oracle for every accelerated backend and the
+    compute path of the pure-Python worker backend (the analogue of the
+    reference's ``miner`` hot loop, worker.go:318-400, minus the
+    per-candidate hex formatting cost noted in BASELINE.md).
+
+    Returns the first solving secret, or None if ``max_candidates`` is
+    exhausted or ``cancel_check`` fires.
+    """
+    nonce = bytes(nonce)
+    tried = 0
+    for _, _, secret in iter_candidates(thread_bytes, start=start_chunk):
+        if cancel_check is not None and tried % cancel_poll_interval == 0:
+            if cancel_check():
+                return None
+        if max_candidates is not None and tried >= max_candidates:
+            return None
+        tried += 1
+        h = hashlib.new(algo)
+        h.update(nonce)
+        h.update(secret)
+        if count_trailing_zero_nibbles(h.digest()) >= num_trailing_zeros:
+            return secret
+    return None

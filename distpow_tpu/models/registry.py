@@ -1,0 +1,84 @@
+"""Pluggable hash-model registry.
+
+SURVEY.md section 0 requires the hash to be "a pluggable kernel and default
+to MD5 for behavioral/trace parity" (the reference hard-codes MD5 at
+worker.go:5,353; BASELINE.json's north star speaks of SHA-256).  A hash
+model bundles everything the packing/search layers need to stay
+hash-agnostic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Sequence, Tuple
+
+from . import md5_jax, sha256_jax
+
+
+@dataclass(frozen=True)
+class HashModel:
+    name: str
+    block_bytes: int
+    digest_words: int          # number of uint32 words in the digest
+    word_byteorder: str        # how digest words map to digest bytes
+    length_byteorder: str      # byte order of the 8-byte bit-length field
+    init_state: Tuple[int, ...]
+    compress: Callable         # (state, words[16]) -> state, vectorized JAX
+    py_compress: Callable      # pure-Python twin, for host-side absorption
+    py_absorb: Callable        # prefix -> (state, remainder, absorbed_len)
+
+    @property
+    def digest_bytes(self) -> int:
+        return self.digest_words * 4
+
+    @property
+    def max_difficulty(self) -> int:
+        """Digest nibble count — difficulties above this are unsatisfiable."""
+        return self.digest_bytes * 2
+
+    def hashlib_new(self):
+        return hashlib.new(self.name)
+
+    def state_to_digest(self, state: Sequence[int]) -> bytes:
+        return b"".join(int(w) .to_bytes(4, self.word_byteorder) for w in state)
+
+
+MD5 = HashModel(
+    name="md5",
+    block_bytes=md5_jax.BLOCK_BYTES,
+    digest_words=md5_jax.DIGEST_WORDS,
+    word_byteorder=md5_jax.WORD_BYTEORDER,
+    length_byteorder=md5_jax.LENGTH_BYTEORDER,
+    init_state=md5_jax.MD5_INIT,
+    compress=md5_jax.md5_compress,
+    py_compress=md5_jax.py_compress,
+    py_absorb=md5_jax.py_absorb,
+)
+
+SHA256 = HashModel(
+    name="sha256",
+    block_bytes=sha256_jax.BLOCK_BYTES,
+    digest_words=sha256_jax.DIGEST_WORDS,
+    word_byteorder=sha256_jax.WORD_BYTEORDER,
+    length_byteorder=sha256_jax.LENGTH_BYTEORDER,
+    init_state=sha256_jax.SHA256_INIT,
+    compress=sha256_jax.sha256_compress,
+    py_compress=sha256_jax.py_compress,
+    py_absorb=sha256_jax.py_absorb,
+)
+
+_REGISTRY: Dict[str, HashModel] = {"md5": MD5, "sha256": SHA256}
+
+
+def get_hash_model(name: str) -> HashModel:
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown hash model {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def register_hash_model(model: HashModel) -> None:
+    _REGISTRY[model.name.lower()] = model

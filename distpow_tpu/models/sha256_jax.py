@@ -1,0 +1,158 @@
+"""SHA-256 as a vectorized JAX computation over uint32 lanes.
+
+The reference's kernel is MD5 (worker.go:5,353) but BASELINE.json's
+north-star text describes the TPU backend as "a jax.vmap'd SHA-256 kernel";
+this framework therefore treats the hash as a *pluggable model*
+(``distpow_tpu.models.registry``) with MD5 as the behavioral-parity default
+and SHA-256 available for the north-star configuration.
+
+Same interface as ``md5_jax`` (16 broadcastable message words in, state
+out), different compilation strategy: SHA-256's rounds are uniform, so they
+are expressed as a ``lax.fori_loop`` (partially unrolled) instead of a
+fully unrolled graph.  An unrolled SHA-256 triggers an exponential
+compile/codegen blowup in XLA:CPU past ~56 rounds (the a/e state words fan
+out ~6x per round and the message schedule is a 4-fan-in recursive DAG);
+the loop form compiles in ~1s on CPU and maps to compiler-friendly static
+control flow on TPU.  MD5 stays unrolled — its round chain is single-use
+and fuses into one flat VPU kernel.  Correctness pinned against
+``hashlib`` in tests/test_hash_models.py.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+SHA256_INIT = (
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+)
+
+SHA256_K = (
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+    0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+    0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+    0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+    0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+    0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+)
+
+BLOCK_BYTES = 64
+DIGEST_WORDS = 8
+WORD_BYTEORDER = "big"
+LENGTH_BYTEORDER = "big"
+
+
+def _u32(x):
+    return x if hasattr(x, "dtype") else jnp.uint32(np.uint32(x))
+
+
+def _rotr(x, s):
+    return (x >> s) | (x << (32 - s))
+
+
+_K_ARRAY = None
+
+
+def _k_array():
+    global _K_ARRAY
+    if _K_ARRAY is None:
+        _K_ARRAY = jnp.asarray(np.array(SHA256_K, np.uint32))
+    return _K_ARRAY
+
+
+def sha256_compress(state, words: Sequence):
+    """One SHA-256 block compression, vectorized over broadcastable words."""
+    ws = [_u32(m) for m in words]
+    shape = jnp.broadcast_shapes(*(jnp.shape(w) for w in ws))
+    w16 = jnp.stack([jnp.broadcast_to(w, shape) for w in ws])
+
+    def sched_body(i, w):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        return w.at[i].set(w[i - 16] + s0 + w[i - 7] + s1)
+
+    w = jnp.zeros((64,) + shape, jnp.uint32).at[:16].set(w16)
+    w = lax.fori_loop(16, 64, sched_body, w, unroll=4)
+
+    K = _k_array()
+
+    def round_body(i, st):
+        a, b, c, d, e, f, g, h = st
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + K[i] + w[i]
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + S0 + maj, a, b, c, d + t1, e, f, g)
+
+    st = tuple(jnp.broadcast_to(_u32(s), shape) for s in state)
+    st = lax.fori_loop(0, 64, round_body, st, unroll=4)
+    return tuple(_u32(s0) + s for s0, s in zip(state, st))
+
+
+def sha256_digest_words(blocks: Sequence[Sequence]) -> Tuple:
+    state = SHA256_INIT
+    for words in blocks:
+        state = sha256_compress(state, words)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python twin (host-side prefix absorption + oracle).
+# ---------------------------------------------------------------------------
+
+_MASK = 0xFFFFFFFF
+
+
+def _py_rotr(x: int, s: int) -> int:
+    return ((x >> s) | (x << (32 - s))) & _MASK
+
+
+def py_compress(state: Tuple[int, ...], block: bytes) -> Tuple[int, ...]:
+    assert len(block) == BLOCK_BYTES
+    w = list(struct.unpack(">16I", block))
+    for i in range(16, 64):
+        s0 = _py_rotr(w[i - 15], 7) ^ _py_rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = _py_rotr(w[i - 2], 17) ^ _py_rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append((w[i - 16] + s0 + w[i - 7] + s1) & _MASK)
+    a, b, c, d, e, f, g, h = state
+    for i in range(64):
+        S1 = _py_rotr(e, 6) ^ _py_rotr(e, 11) ^ _py_rotr(e, 25)
+        ch = (e & f) ^ (~e & g & _MASK)
+        t1 = (h + S1 + ch + SHA256_K[i] + w[i]) & _MASK
+        S0 = _py_rotr(a, 2) ^ _py_rotr(a, 13) ^ _py_rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (S0 + maj) & _MASK
+        h, g, f, e, d, c, b, a = g, f, e, (d + t1) & _MASK, c, b, a, (t1 + t2) & _MASK
+    out = (a, b, c, d, e, f, g, h)
+    return tuple((s0 + s) & _MASK for s0, s in zip(state, out))
+
+
+def py_absorb(prefix: bytes):
+    state = SHA256_INIT
+    n_full = len(prefix) // BLOCK_BYTES
+    for i in range(n_full):
+        state = py_compress(state, prefix[i * BLOCK_BYTES : (i + 1) * BLOCK_BYTES])
+    return state, prefix[n_full * BLOCK_BYTES :], n_full * BLOCK_BYTES
+
+
+def py_digest(message: bytes) -> bytes:
+    state, rem, _ = py_absorb(message)
+    total = len(message)
+    tail = rem + b"\x80"
+    pad = (-len(tail) - 8) % BLOCK_BYTES
+    tail += b"\x00" * pad + struct.pack(">Q", total * 8)
+    for i in range(0, len(tail), BLOCK_BYTES):
+        state = py_compress(state, tail[i : i + BLOCK_BYTES])
+    return b"".join(w.to_bytes(4, "big") for w in state)

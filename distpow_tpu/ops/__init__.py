@@ -1,0 +1,8 @@
+from .difficulty import meets_difficulty, nibble_masks
+from .packing import TailSpec, build_tail_spec, make_words
+from .search_step import SENTINEL, build_search_step, cached_search_step
+
+__all__ = [
+    "meets_difficulty", "nibble_masks", "TailSpec", "build_tail_spec",
+    "make_words", "SENTINEL", "build_search_step", "cached_search_step",
+]

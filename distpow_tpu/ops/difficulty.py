@@ -1,0 +1,65 @@
+"""Trailing-zero-nibble difficulty check as static uint32 word masks.
+
+The reference hex-formats every digest and counts trailing ``'0'``
+characters (worker.go:354-356) — a per-candidate string allocation in the
+hot loop (called out in BASELINE.md as headroom).  A trailing ``'0'`` hex
+character is exactly a zero nibble of the raw digest, scanned from the end:
+low nibble of the last byte, high nibble of the last byte, low nibble of
+the second-to-last byte, ...
+
+For a *static* difficulty ``k`` (fixed per kernel launch) the predicate
+"digest has >= k trailing zero nibbles" is therefore a constant bitmask per
+digest word: OR together the masked words and compare with zero.  No
+strings, no branches, pure VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..models.registry import HashModel
+
+
+def nibble_masks(k: int, model: HashModel) -> Tuple[int, ...]:
+    """Per-digest-word uint32 masks covering the last ``k`` nibbles.
+
+    The digest has >= k trailing zero nibbles iff ``(word_i & mask_i) == 0``
+    for every word.  ``k`` may be 0 (all masks zero => always true) up to
+    ``model.max_difficulty``.
+    """
+    if k < 0:
+        raise ValueError("difficulty must be non-negative")
+    if k > model.max_difficulty:
+        # A digest only has max_difficulty nibbles: such a puzzle is
+        # unsatisfiable (the reference would search forever,
+        # worker.go:246-256 can never reach the threshold).  Callers gate
+        # on max_difficulty before building masks.
+        raise ValueError(
+            f"difficulty {k} exceeds {model.name}'s digest nibble count "
+            f"({model.max_difficulty}); the puzzle is unsatisfiable"
+        )
+    masks = [0] * model.digest_words
+    digest_bytes = model.digest_bytes
+    for t in range(k):
+        byte_idx = digest_bytes - 1 - t // 2
+        nib = 0x0F if t % 2 == 0 else 0xF0
+        word, j = divmod(byte_idx, 4)
+        shift = 8 * j if model.word_byteorder == "little" else 8 * (3 - j)
+        masks[word] |= nib << shift
+    return tuple(masks)
+
+
+def meets_difficulty(state: Sequence, masks: Sequence[int]):
+    """Vectorized predicate: True where the digest words pass the masks."""
+    acc = None
+    for w, m in zip(state, masks):
+        if m == 0:
+            continue
+        term = jnp.asarray(w, jnp.uint32) & jnp.uint32(m)
+        acc = term if acc is None else (acc | term)
+    if acc is None:
+        ones = jnp.asarray(state[0], jnp.uint32)
+        return jnp.ones(jnp.shape(ones), dtype=bool)
+    return acc == 0

@@ -1,0 +1,145 @@
+"""Arithmetic candidate -> message-word packing.
+
+The reference builds every candidate message as a byte buffer
+(``nonce ‖ threadByte ‖ chunk``, worker.go:346-352) and hashes it.  On TPU
+we never materialize bytes: a candidate is identified by the pair
+``(thread_byte, chunk_int)`` (see ``models.puzzle`` for the chunk<->int
+bijection) and the 16 uint32 message words of the hash's final block(s) are
+computed *arithmetically* from those two integers plus a precomputed
+constant template.
+
+The template (``TailSpec``) is built once per (nonce, chunk width, hash
+model) on the host:
+
+* all complete 64-byte blocks of the constant nonce prefix are absorbed
+  into the hash state host-side (``HashModel.py_absorb``), so arbitrarily
+  long nonces cost nothing per candidate;
+* the remaining tail — ``nonce_remainder ‖ thread_byte ‖ chunk ‖ 0x80
+  padding ‖ bit-length`` — spans one or two blocks whose constant bytes are
+  baked into ``base_words`` and whose two variable fields are described by
+  (block, word, shift) byte locations.
+
+On device, ``make_words`` turns broadcastable uint32 arrays of thread bytes
+and chunk values into the per-candidate word lists consumed by
+``HashModel.compress``; only the handful of words containing variable bytes
+become batch-shaped arrays, the rest stay scalars that XLA constant-folds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..models.registry import HashModel
+
+ByteLoc = Tuple[int, int, int]  # (block index, word index, bit shift)
+
+
+@dataclass(frozen=True)
+class TailSpec:
+    """Device-side description of the final block(s) for one chunk width."""
+
+    model_name: str
+    nonce_len: int
+    width: int                      # chunk byte width (0 => no chunk bytes)
+    init_state: Tuple[int, ...]     # state after absorbing full nonce blocks
+    n_blocks: int                   # tail blocks to compress on device (1-2)
+    base_words: Tuple[Tuple[int, ...], ...]  # [n_blocks][16] constant words
+    tb_loc: ByteLoc                 # where the thread byte lands
+    chunk_locs: Tuple[ByteLoc, ...]  # where chunk byte j (LE) lands, j < width
+
+    @property
+    def secret_len(self) -> int:
+        return 1 + self.width
+
+
+def _byte_loc(pos: int, model: HashModel) -> ByteLoc:
+    """Map a byte offset within the tail to (block, word, shift)."""
+    block, off = divmod(pos, model.block_bytes)
+    word, j = divmod(off, 4)
+    shift = 8 * j if model.word_byteorder == "little" else 8 * (3 - j)
+    return block, word, shift
+
+
+def build_tail_spec(
+    nonce: bytes, width: int, model: HashModel, extra_const_chunk: bytes = b""
+) -> TailSpec:
+    """Build the packing template for candidates ``nonce ‖ tb ‖ chunk``.
+
+    ``width`` counts the chunk bytes that vary on device (<= 4, so the chunk
+    fits a uint32 lane).  ``extra_const_chunk`` holds any *constant* high
+    chunk bytes appended after the variable ones — the search driver uses
+    this to reach chunk widths beyond 4 bytes by fixing the high bytes per
+    launch segment.
+    """
+    if not 0 <= width <= 4:
+        raise ValueError("variable chunk width must be in [0, 4]")
+    nonce = bytes(nonce)
+    state, rem, _ = model.py_absorb(nonce)
+    msg_len = len(nonce) + 1 + width + len(extra_const_chunk)
+
+    # Tail layout: rem ‖ [tb] ‖ [chunk×width] ‖ extra ‖ 0x80 ‖ 0… ‖ len64
+    content = len(rem) + 1 + width + len(extra_const_chunk)
+    n_blocks = (content + 1 + 8 + model.block_bytes - 1) // model.block_bytes
+    tail = bytearray(n_blocks * model.block_bytes)
+    tail[: len(rem)] = rem
+    # tb and chunk bytes stay zero in the template; recorded as locations.
+    tb_pos = len(rem)
+    chunk_pos0 = tb_pos + 1
+    extra_pos = chunk_pos0 + width
+    tail[extra_pos : extra_pos + len(extra_const_chunk)] = extra_const_chunk
+    tail[extra_pos + len(extra_const_chunk)] = 0x80
+    tail[-8:] = (msg_len * 8).to_bytes(8, model.length_byteorder)
+
+    fmt_order = model.word_byteorder
+    base_words: List[Tuple[int, ...]] = []
+    for b in range(n_blocks):
+        blk = tail[b * model.block_bytes : (b + 1) * model.block_bytes]
+        base_words.append(
+            tuple(
+                int.from_bytes(blk[4 * w : 4 * w + 4], fmt_order)
+                for w in range(16)
+            )
+        )
+
+    return TailSpec(
+        model_name=model.name,
+        nonce_len=len(nonce),
+        width=width,
+        init_state=tuple(state),
+        n_blocks=n_blocks,
+        base_words=tuple(base_words),
+        tb_loc=_byte_loc(tb_pos, model),
+        chunk_locs=tuple(_byte_loc(chunk_pos0 + j, model) for j in range(width)),
+    )
+
+
+def make_words(spec: TailSpec, tb, chunk) -> List[List]:
+    """Materialize the tail block word lists for a batch of candidates.
+
+    ``tb`` and ``chunk`` are broadcast-compatible uint32 arrays (or ints).
+    Returns ``spec.n_blocks`` lists of 16 entries, each an int (constant
+    word) or an array (word containing variable bytes).
+    """
+    tb = jnp.asarray(tb, jnp.uint32)
+    chunk = jnp.asarray(chunk, jnp.uint32)
+    blocks: List[List] = [list(bw) for bw in spec.base_words]
+
+    b, w, s = spec.tb_loc
+    blocks[b][w] = jnp.uint32(blocks[b][w]) | (tb << s)
+    for j, (b, w, s) in enumerate(spec.chunk_locs):
+        byte_j = (chunk >> (8 * j)) & jnp.uint32(0xFF)
+        cur = blocks[b][w]
+        cur = jnp.uint32(cur) if not hasattr(cur, "dtype") else cur
+        blocks[b][w] = cur | (byte_j << s)
+    return blocks
+
+
+def pack_reference_bytes(
+    nonce: bytes, tb: int, chunk_int: int, width: int, extra_const_chunk: bytes = b""
+) -> bytes:
+    """Host-side twin of make_words for tests: the exact message bytes."""
+    chunk = int(chunk_int).to_bytes(width, "little") if width else b""
+    return bytes(nonce) + bytes([tb]) + chunk + extra_const_chunk

@@ -1,0 +1,101 @@
+"""The fused proof-of-work search step — the framework's hot op.
+
+One step evaluates ``chunks_per_step × tb_count`` candidates entirely on
+device: flat index -> (chunk, thread byte) -> message words -> hash state
+-> difficulty mask -> argmin of hits, returning a single uint32 (the flat
+index of the first hit in reference enumeration order, or SENTINEL).
+
+This replaces the reference's per-candidate loop body (worker.go:346-356).
+Reference order is preserved exactly: the flat index is chunk-major,
+thread-byte-minor, matching the nested loop at worker.go:318-319 where all
+thread bytes are tried for each chunk value before the chunk advances.
+
+Everything except the chunk base is static, so each (nonce length, width,
+difficulty, partition, batch) tuple compiles once and is re-dispatched with
+a new ``chunk0`` scalar every step — no recompiles in the steady state, no
+host<->device traffic beyond one scalar in and one scalar out.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.registry import HashModel, get_hash_model
+from .difficulty import meets_difficulty, nibble_masks
+from .packing import TailSpec, build_tail_spec, make_words
+
+SENTINEL = 0xFFFFFFFF
+
+
+def _eval_candidates(spec: TailSpec, masks, model: HashModel, tb, chunk):
+    """Hash a broadcastable batch of candidates and return the hit mask."""
+    state = spec.init_state
+    for b in range(spec.n_blocks):
+        words = make_words(spec, tb, chunk)[b]
+        state = model.compress(state, words)
+    return meets_difficulty(state, masks)
+
+
+def build_search_step(
+    nonce: bytes,
+    width: int,
+    difficulty: int,
+    tb_lo: int,
+    tb_count: int,
+    chunks_per_step: int,
+    model: HashModel,
+    extra_const_chunk: bytes = b"",
+    jit: bool = True,
+) -> Callable:
+    """Build ``step(chunk0: uint32) -> uint32`` for one chunk width.
+
+    The thread bytes scanned are ``tb_lo .. tb_lo + tb_count - 1`` (the
+    partition algebra always yields contiguous runs; parallel/partition.py).
+    """
+    spec = build_tail_spec(nonce, width, model, extra_const_chunk)
+    masks = nibble_masks(difficulty, model)
+    batch = chunks_per_step * tb_count
+
+    def step(chunk0):
+        f = jnp.arange(batch, dtype=jnp.uint32)
+        chunk = jnp.uint32(chunk0) + f // jnp.uint32(tb_count)
+        tb = jnp.uint32(tb_lo) + f % jnp.uint32(tb_count)
+        hit = _eval_candidates(spec, masks, model, tb, chunk)
+        return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
+
+    return jax.jit(step) if jit else step
+
+
+@functools.lru_cache(maxsize=64)
+def cached_search_step(
+    nonce: bytes,
+    width: int,
+    difficulty: int,
+    tb_lo: int,
+    tb_count: int,
+    chunks_per_step: int,
+    model_name: str,
+    extra_const_chunk: bytes = b"",
+):
+    """Memoized ``build_search_step`` keyed on every static parameter."""
+    return build_search_step(
+        nonce,
+        width,
+        difficulty,
+        tb_lo,
+        tb_count,
+        chunks_per_step,
+        get_hash_model(model_name),
+        extra_const_chunk,
+    )
+
+
+def flat_to_candidate(
+    f: int, chunk0: int, tb_lo: int, tb_count: int
+) -> Tuple[int, int]:
+    """Host-side inverse of the step's index map: flat -> (chunk, tb)."""
+    return chunk0 + f // tb_count, tb_lo + f % tb_count

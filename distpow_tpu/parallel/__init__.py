@@ -1,0 +1,8 @@
+from .partition import remainder_bits, split_thread_bytes, thread_bytes, worker_bits
+from .search import SearchResult, search
+from .mesh_search import make_mesh, search_mesh
+
+__all__ = [
+    "remainder_bits", "split_thread_bytes", "thread_bytes", "worker_bits",
+    "SearchResult", "search", "make_mesh", "search_mesh",
+]

@@ -1,0 +1,146 @@
+"""Multi-device search over a ``jax.sharding.Mesh`` — prefix -> core.
+
+This is the TPU-native form of the coordinator's fan-out (SURVEY.md
+section 2, strategies 1-2): inside one worker process, the worker's
+thread-byte range is sub-partitioned across the devices of a mesh exactly
+the way the coordinator partitions it across workers
+(coordinator.go:326, worker.go:301-316) — prefix -> core instead of
+prefix -> RPC peer.  The "first result wins, everyone stops" protocol
+(coordinator.go:202-230) compresses onto ICI: every step ends in a
+``lax.pmin`` of the per-device first-hit flat index, so all devices
+observe a win at the same step boundary and the host stops dispatching —
+the Found broadcast without any RPC.
+
+Two sharding regimes, chosen automatically:
+
+* **thread-byte split** (the common case): each device owns a contiguous
+  slice of the thread-byte run and scans the same chunk range in lockstep.
+* **chunk split** (when there are fewer thread bytes than devices): each
+  device owns a contiguous slice of the chunk range instead.
+
+Both regimes report hits as *global* flat indices (chunk-major,
+thread-byte-minor over the whole worker partition), so the driver's decode
+and the reference enumeration-order guarantee are identical to the
+single-device path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.registry import HashModel, get_hash_model
+from ..ops.difficulty import nibble_masks
+from ..ops.packing import build_tail_spec
+from ..ops.search_step import SENTINEL, _eval_candidates
+from .search import SearchResult, StepFactory, contiguous_bounds, search
+
+AXIS = "workers"
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis: str = AXIS) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (axis,))
+
+
+def _mesh_step_factory(
+    nonce: bytes,
+    difficulty: int,
+    tb_lo: int,
+    tbc: int,
+    model: HashModel,
+    mesh: Mesh,
+    axis: str,
+) -> StepFactory:
+    n_dev = mesh.devices.size
+    tb_split = tbc >= n_dev and tbc % n_dev == 0
+
+    @functools.lru_cache(maxsize=32)
+    def build(vw: int, extra: bytes, chunks_local: int):
+        spec = build_tail_spec(bytes(nonce), vw, model, extra)
+        masks = nibble_masks(difficulty, model)
+
+        if tb_split:
+            tbl = tbc // n_dev
+
+            def body(chunk0):
+                d = jax.lax.axis_index(axis).astype(jnp.uint32)
+                fl = jnp.arange(chunks_local * tbl, dtype=jnp.uint32)
+                chunk_off = fl // jnp.uint32(tbl)
+                tb_local = fl % jnp.uint32(tbl)
+                tb = jnp.uint32(tb_lo) + d * jnp.uint32(tbl) + tb_local
+                chunk = jnp.uint32(chunk0) + chunk_off
+                hit = _eval_candidates(spec, masks, model, tb, chunk)
+                f_global = (
+                    chunk_off * jnp.uint32(tbc)
+                    + d * jnp.uint32(tbl)
+                    + tb_local
+                )
+                m = jnp.min(jnp.where(hit, f_global, jnp.uint32(SENTINEL)))
+                return jax.lax.pmin(m, axis)
+
+        else:
+
+            def body(chunk0):
+                d = jax.lax.axis_index(axis).astype(jnp.uint32)
+                fl = jnp.arange(chunks_local * tbc, dtype=jnp.uint32)
+                chunk_off_local = fl // jnp.uint32(tbc)
+                tb_idx = fl % jnp.uint32(tbc)
+                chunk_off = d * jnp.uint32(chunks_local) + chunk_off_local
+                tb = jnp.uint32(tb_lo) + tb_idx
+                chunk = jnp.uint32(chunk0) + chunk_off
+                hit = _eval_candidates(spec, masks, model, tb, chunk)
+                f_global = chunk_off * jnp.uint32(tbc) + tb_idx
+                m = jnp.min(jnp.where(hit, f_global, jnp.uint32(SENTINEL)))
+                return jax.lax.pmin(m, axis)
+
+        sharded = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P())
+        return jax.jit(sharded)
+
+    def factory(vw: int, extra: bytes, target_chunks: int):
+        if vw == 0:
+            chunks_local = 1
+        elif tb_split:
+            # every device scans the same chunks on its own tb slice
+            chunks_local = max(1, target_chunks)
+        else:
+            chunks_local = max(1, target_chunks // n_dev)
+        step = build(vw, bytes(extra), chunks_local)
+        global_chunks = chunks_local if tb_split else chunks_local * n_dev
+        if vw == 0:
+            global_chunks = 1
+        return step, global_chunks
+
+    return factory
+
+
+def search_mesh(
+    nonce: bytes,
+    difficulty: int,
+    thread_bytes: Sequence[int],
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = AXIS,
+    model: Optional[HashModel] = None,
+    **kwargs,
+) -> Optional[SearchResult]:
+    """Mesh-parallel ``search`` with identical semantics and result decode."""
+    model = model or get_hash_model("md5")
+    mesh = mesh if mesh is not None else make_mesh()
+    tb_lo, tbc = contiguous_bounds(thread_bytes)
+    factory = _mesh_step_factory(
+        bytes(nonce), difficulty, tb_lo, tbc, model, mesh, axis
+    )
+    return search(
+        nonce,
+        difficulty,
+        thread_bytes,
+        model=model,
+        step_factory=factory,
+        **kwargs,
+    )

@@ -1,0 +1,77 @@
+"""Search-space partition algebra: byte-prefix sharding of the secret space.
+
+The reference's single parallelism strategy (SURVEY.md section 2, component
+10): the first secret byte is partitioned by a high-order worker-index
+prefix.  The coordinator computes ``worker_bits = floor(log2(num_workers))``
+(coordinator.go:326) and sends each worker its index as ``WorkerByte``
+(coordinator.go:127,190-191).  Each worker expands that prefix into its set
+of "thread bytes" — the possible first secret bytes it owns
+(worker.go:301-316):
+
+    remainder_bits = 8 - (worker_bits % 9)
+    thread_bytes[i] = uint8((worker_byte << remainder_bits) | i)
+                      for i in range(2 ** remainder_bits)
+
+Quirks faithfully preserved (and documented, per SURVEY.md section 7):
+
+* ``worker_bits`` truncates ``log2`` — for non-power-of-two worker counts
+  the high-indexed workers' prefixes wrap around (uint8 conversion) and
+  *overlap* the low workers' shards.  Coverage of the full byte space is
+  preserved; work is duplicated.  This matches the reference bug-for-bug,
+  because overlap is harmless (any valid secret is acceptable) while gaps
+  would not be.
+* ``% 9`` only matters for >= 512 workers where ``worker_bits`` exceeds 8.
+
+On TPU the same algebra is applied twice: once across workers (this module,
+driven by the coordinator) and once more across the devices of a worker's
+mesh (``split_thread_bytes``), so the prefix -> core mapping of
+BASELINE.json falls out of the same partition function.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+
+def worker_bits(num_workers: int) -> int:
+    """``uint(math.Log2(num_workers))`` as in coordinator.go:326."""
+    if num_workers <= 0:
+        raise ValueError("num_workers must be positive")
+    return int(math.log2(num_workers))
+
+
+def remainder_bits(bits: int) -> int:
+    """``8 - (worker_bits % 9)`` as in worker.go:302."""
+    return 8 - (bits % 9)
+
+
+def thread_bytes(worker_byte: int, bits: int) -> List[int]:
+    """The worker's owned first-secret-byte values (worker.go:312-316).
+
+    The ``& 0xFF`` reproduces Go's uint8 conversion, which makes
+    out-of-range prefixes wrap (overlapping low shards) instead of erroring.
+    """
+    r = remainder_bits(bits)
+    return [((worker_byte << r) | i) & 0xFF for i in range(1 << r)]
+
+
+def split_thread_bytes(tbs: Sequence[int], num_shards: int) -> List[List[int]]:
+    """Sub-partition a worker's thread bytes across mesh devices.
+
+    Contiguous split so that each device owns a contiguous prefix range
+    (prefix -> core).  When there are fewer thread bytes than devices the
+    surplus devices receive empty shards (the mesh driver then falls back to
+    chunk-range splitting).
+    """
+    if num_shards <= 0:
+        raise ValueError("num_shards must be positive")
+    n = len(tbs)
+    base, rem = divmod(n, num_shards)
+    shards: List[List[int]] = []
+    pos = 0
+    for s in range(num_shards):
+        size = base + (1 if s < rem else 0)
+        shards.append(list(tbs[pos : pos + size]))
+        pos += size
+    return shards

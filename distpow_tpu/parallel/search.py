@@ -1,0 +1,204 @@
+"""Batched search drivers (single-device and factory-pluggable).
+
+Replaces the reference worker's ``miner`` goroutine hot loop
+(worker.go:258-401).  Differences dictated by the accelerator model
+(SURVEY.md section 7 "hard parts"):
+
+* The reference enumerates one candidate at a time and polls its cancel
+  channel every iteration (worker.go:320-345).  A TPU kernel is
+  uninterruptible, so the driver dispatches fixed-size batches and checks
+  ``cancel_check`` between dispatches — cancellation latency is bounded by
+  one batch.
+* The chunk counter grows by appending carry bytes (worker.go:234-244),
+  changing the message length.  The driver therefore runs one fused-step
+  specialization per chunk *width* (0, 1, 2, ... bytes); within a width the
+  space is a dense integer range and the kernel maps flat indices to
+  candidates arithmetically.  Widths above 4 bytes (beyond uint32 lanes)
+  fix the high chunk bytes per launch segment.
+* Dispatches are pipelined (depth 2 by default) so the host prepares launch
+  N+1 while the device crunches launch N; results are drained FIFO, which
+  preserves reference enumeration order for the returned first match.
+
+Batch-boundary note: a width-``w`` launch whose chunk range overruns
+``256**w`` hashes candidates whose ``w``-byte little-endian chunk encoding
+has a zero top byte.  Those are not in the reference's canonical
+enumeration (its encodings are minimal) but they are perfectly valid
+secrets under the puzzle contract — any solving secret is acceptable
+(coordinator.go:202 takes whichever result arrives first) — so the driver
+accepts them rather than paying a tail recompile per width.  Every result
+is re-verified host-side with hashlib before being returned.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence, Tuple
+
+from ..models import puzzle
+from ..models.registry import HashModel, get_hash_model
+from ..ops.search_step import SENTINEL, cached_search_step
+
+DEFAULT_BATCH = 1 << 20
+DEFAULT_PIPELINE_DEPTH = 2
+
+# A step factory maps (variable_width, extra_const_chunk, target_chunks) to
+# (step_fn, chunks_per_step) where step_fn(chunk0)->uint32 evaluates
+# chunks_per_step * tb_count candidates starting at chunk0 and returns the
+# flat index (chunk-major, thread-byte-minor, i.e. reference enumeration
+# order, worker.go:318-319) of the first hit, or SENTINEL.
+StepFactory = Callable[[int, bytes, int], Tuple[Callable, int]]
+
+
+@dataclass
+class SearchResult:
+    secret: bytes
+    thread_byte: int
+    chunk: bytes
+    hashes_tried: int
+
+
+def contiguous_bounds(thread_bytes: Sequence[int]) -> Tuple[int, int]:
+    """(tb_lo, count) for a contiguous ascending thread-byte run.
+
+    The partition algebra (parallel/partition.py, mirroring worker.go:312-316)
+    always yields such runs; the arithmetic index map relies on it.
+    """
+    tbs = list(thread_bytes)
+    if not tbs:
+        raise ValueError("empty thread byte set")
+    lo = tbs[0]
+    if tbs != list(range(lo, lo + len(tbs))):
+        raise ValueError(f"thread bytes not a contiguous run: {tbs[:8]}...")
+    return lo, len(tbs)
+
+
+def width_segments(width: int):
+    """Yield (variable_width, chunk_lo, chunk_hi, extra_const_chunk) for one
+    chunk width.  For width <= 4 the whole width is one dense uint32 range;
+    beyond that the high bytes are fixed per segment."""
+    if width == 0:
+        yield 0, 0, 1, b""
+        return
+    if width <= 4:
+        yield width, 256 ** (width - 1), 256 ** width, b""
+        return
+    hi_w = width - 4
+    for hi in range(256 ** (hi_w - 1), 256 ** hi_w):
+        yield 4, 0, 1 << 32, hi.to_bytes(hi_w, "little")
+
+
+def default_step_factory(
+    nonce: bytes,
+    difficulty: int,
+    tb_lo: int,
+    tb_count: int,
+    model: HashModel,
+) -> StepFactory:
+    """Single-device factory over the fused XLA search step."""
+
+    def factory(vw: int, extra: bytes, target_chunks: int):
+        chunks = max(1, target_chunks) if vw else 1
+        step = cached_search_step(
+            bytes(nonce), vw, difficulty, tb_lo, tb_count,
+            chunks, model.name, extra,
+        )
+        return step, chunks
+
+    return factory
+
+
+def search(
+    nonce: bytes,
+    difficulty: int,
+    thread_bytes: Sequence[int],
+    *,
+    model: Optional[HashModel] = None,
+    batch_size: int = DEFAULT_BATCH,
+    pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+    cancel_check: Optional[Callable[[], bool]] = None,
+    max_hashes: Optional[int] = None,
+    max_width: int = 8,
+    step_factory: Optional[StepFactory] = None,
+) -> Optional[SearchResult]:
+    """Find the first (reference-enumeration-order) solving secret.
+
+    Returns None if cancelled or ``max_hashes`` exhausted.  ``step_factory``
+    overrides the launch builder — the mesh driver (parallel/mesh_search.py)
+    and the Pallas kernel path (ops/md5_pallas.py) plug in here.
+    """
+    model = model or get_hash_model("md5")
+    nonce = bytes(nonce)
+    tb_lo, tbc = contiguous_bounds(thread_bytes)
+    if difficulty > model.max_difficulty:
+        # Unsatisfiable: the digest only has max_difficulty nibbles.  The
+        # reference would brute-force forever (worker.go:246-256 never
+        # reaches the threshold); we busy-wait on the cancel/budget gates
+        # instead of burning the device.
+        import time
+
+        while True:
+            if cancel_check is not None and cancel_check():
+                return None
+            if max_hashes is not None:
+                return None
+            time.sleep(0.01)
+    factory = step_factory or default_step_factory(
+        nonce, difficulty, tb_lo, tbc, model
+    )
+    target_chunks = max(1, batch_size // tbc)
+
+    hashes = 0
+    # FIFO of in-flight launches: (result, chunk0, var_width, extra, n_cand)
+    inflight: deque = deque()
+
+    def drain_one() -> Optional[SearchResult]:
+        nonlocal hashes
+        res, chunk0, vw, extra, n_cand = inflight.popleft()
+        hashes += n_cand
+        f = int(res)
+        if f == SENTINEL:
+            return None
+        chunk_int = (chunk0 + f // tbc) & 0xFFFFFFFF
+        tb = tb_lo + f % tbc
+        chunk_bytes = (
+            (chunk_int & (256 ** vw - 1)).to_bytes(vw, "little") if vw else b""
+        ) + extra
+        secret = bytes([tb]) + chunk_bytes
+        if not puzzle.check_secret(nonce, secret, difficulty, model.name):
+            raise RuntimeError(
+                f"kernel returned non-solving candidate tb={tb} "
+                f"chunk={chunk_bytes.hex()} (kernel/oracle divergence)"
+            )
+        return SearchResult(
+            secret=secret, thread_byte=tb, chunk=chunk_bytes, hashes_tried=hashes
+        )
+
+    def drain_all() -> Optional[SearchResult]:
+        while inflight:
+            found = drain_one()
+            if found is not None:
+                return found
+        return None
+
+    for width in range(0, max_width + 1):
+        for vw, lo, hi, extra in width_segments(width):
+            step, chunks_per_step = factory(vw, extra, target_chunks)
+            n_cand = chunks_per_step * tbc
+            chunk0 = lo
+            while chunk0 < hi:
+                if cancel_check is not None and cancel_check():
+                    return None
+                if max_hashes is not None and hashes >= max_hashes:
+                    return drain_all()
+                res = step(chunk0 & 0xFFFFFFFF)
+                inflight.append((res, chunk0, vw, extra, n_cand))
+                chunk0 += chunks_per_step
+                if len(inflight) >= pipeline_depth:
+                    found = drain_one()
+                    if found is not None:
+                        return found
+            found = drain_all()
+            if found is not None:
+                return found
+    return None

@@ -1,0 +1,117 @@
+"""Nonce-keyed dominance result cache with trace emission.
+
+One implementation serving both the coordinator and worker roles.  The
+reference duplicates this logic verbatim in both nodes
+(coordinator.go:390-473 vs worker.go:423-506); per SURVEY.md section 7
+item 2 we deliberately de-duplicate — semantics are identical:
+
+* key: the raw nonce bytes (coordinator.go:479-481, worker.go:512-514);
+  one entry per nonce.
+* ``get`` hits iff the entry's difficulty >= the requested difficulty
+  (coordinator.go:403); every lookup records ``CacheHit`` (with the stored
+  secret) or ``CacheMiss``.
+* ``add`` installs when no entry exists; replaces when the new entry has
+  strictly more trailing zeros (coordinator.go:436) or equal zeros and a
+  lexicographically greater secret (``bytes.Compare > 0``,
+  coordinator.go:454) — the "dominance" order that keeps all replicas
+  convergent regardless of result arrival order.  Replacement records
+  ``CacheRemove`` then ``CacheAdd``; a dominated insert records nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from .actions import CacheAdd, CacheHit, CacheMiss, CacheRemove
+from .tracing import Trace
+
+
+@dataclass
+class CacheEntry:
+    num_trailing_zeros: int
+    secret: bytes
+
+
+class ResultCache:
+    def __init__(self):
+        self._entries: Dict[bytes, CacheEntry] = {}
+        self._lock = threading.Lock()
+
+    def get(
+        self, nonce: bytes, num_trailing_zeros: int, trace: Optional[Trace]
+    ) -> Optional[bytes]:
+        nonce = bytes(nonce)
+        with self._lock:
+            entry = self._entries.get(nonce)
+            if entry is not None and entry.num_trailing_zeros >= num_trailing_zeros:
+                if trace:
+                    trace.record_action(
+                        CacheHit(
+                            nonce=nonce,
+                            num_trailing_zeros=num_trailing_zeros,
+                            secret=entry.secret,
+                        )
+                    )
+                return entry.secret
+            if trace:
+                trace.record_action(
+                    CacheMiss(nonce=nonce, num_trailing_zeros=num_trailing_zeros)
+                )
+            return None
+
+    def add(
+        self,
+        nonce: bytes,
+        num_trailing_zeros: int,
+        secret: bytes,
+        trace: Optional[Trace],
+    ) -> bool:
+        """Install/replace per the dominance order; True if the cache changed."""
+        nonce, secret = bytes(nonce), bytes(secret)
+        with self._lock:
+            entry = self._entries.get(nonce)
+            if entry is None:
+                self._entries[nonce] = CacheEntry(num_trailing_zeros, secret)
+                if trace:
+                    trace.record_action(
+                        CacheAdd(
+                            nonce=nonce,
+                            num_trailing_zeros=num_trailing_zeros,
+                            secret=secret,
+                        )
+                    )
+                return True
+            dominates = num_trailing_zeros > entry.num_trailing_zeros or (
+                num_trailing_zeros == entry.num_trailing_zeros
+                and secret > entry.secret
+            )
+            if not dominates:
+                return False
+            if trace:
+                trace.record_action(
+                    CacheRemove(
+                        nonce=nonce,
+                        num_trailing_zeros=entry.num_trailing_zeros,
+                        secret=entry.secret,
+                    )
+                )
+                trace.record_action(
+                    CacheAdd(
+                        nonce=nonce,
+                        num_trailing_zeros=num_trailing_zeros,
+                        secret=secret,
+                    )
+                )
+            self._entries[nonce] = CacheEntry(num_trailing_zeros, secret)
+            return True
+
+    def peek(self, nonce: bytes) -> Optional[CacheEntry]:
+        """Inspect without tracing (tests/diagnostics)."""
+        with self._lock:
+            return self._entries.get(bytes(nonce))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

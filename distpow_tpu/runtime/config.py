@@ -1,0 +1,113 @@
+"""Per-node JSON configuration (SURVEY.md section 2 component 14).
+
+Field names match the reference's config JSON exactly
+(config/client_config.json, config/coordinator_config.json,
+config/worker_config.json, config/tracing_server_config.json via
+``ReadJSONConfig``, config.go:8-18) so existing reference config files
+load unchanged.  TPU-specific extensions are additive with defaults:
+
+* ``WorkerConfig.Backend``   — miner backend: ``jax`` (single device,
+  default), ``jax-mesh`` (shard_map over all local devices), ``python``
+  (hashlib loop, the CPU-parity baseline), ``native`` (C++ miner).
+* ``WorkerConfig.HashModel`` — ``md5`` (reference parity, default) or
+  ``sha256`` (north-star variant).
+* ``WorkerConfig.BatchSize`` — candidates per device launch.
+
+Unknown JSON fields are ignored (forward compatibility); missing fields
+take dataclass defaults.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def _decode_secret(v) -> bytes:
+    """Reference configs store TracerSecret as a base64-ish JSON string
+    (Go unmarshals ``string`` -> ``[]byte`` via base64); accept str, list
+    of ints, or empty."""
+    if v is None or v == "":
+        return b""
+    if isinstance(v, str):
+        try:
+            return base64.b64decode(v)
+        except Exception:
+            return v.encode()
+    return bytes(v)
+
+
+@dataclass
+class ClientConfig:
+    ClientID: str = "client1"
+    CoordAddr: str = ""
+    TracerServerAddr: str = ""
+    TracerSecret: bytes = b""
+    ChCapacity: int = 10  # client.go:9
+
+
+@dataclass
+class CoordinatorConfig:
+    ClientAPIListenAddr: str = ""
+    WorkerAPIListenAddr: str = ""
+    Workers: List[str] = field(default_factory=list)
+    TracerServerAddr: str = ""
+    TracerSecret: bytes = b""
+
+
+@dataclass
+class WorkerConfig:
+    WorkerID: str = "worker1"
+    ListenAddr: str = ""
+    CoordAddr: str = ""
+    TracerServerAddr: str = ""
+    TracerSecret: bytes = b""
+    # --- TPU-native extensions -------------------------------------------
+    Backend: str = "jax"
+    HashModel: str = "md5"
+    BatchSize: int = 1 << 20
+    MeshDevices: int = 0  # 0 = all local devices (jax-mesh backend)
+
+
+@dataclass
+class TracingServerConfig:
+    ServerBind: str = ""
+    Secret: bytes = b""
+    OutputFile: str = "trace_output.log"
+    ShivizOutputFile: str = "shiviz_output.log"
+
+
+def from_dict(cls: Type[T], data: dict) -> T:
+    known = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in data.items():
+        f = known.get(k)
+        if f is None:
+            continue
+        if f.type in ("bytes",) or k in ("TracerSecret", "Secret"):
+            v = _decode_secret(v)
+        kwargs[k] = v
+    return cls(**kwargs)
+
+
+def read_json_config(path: str, cls: Type[T]) -> T:
+    """ReadJSONConfig equivalent (config.go:8-18)."""
+    with open(path) as f:
+        return from_dict(cls, json.load(f))
+
+
+def write_json_config(path: str, cfg) -> None:
+    data = {}
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if isinstance(v, bytes):
+            v = base64.b64encode(v).decode()
+        data[f.name] = v
+    with open(path, "w") as fp:
+        json.dump(data, fp, indent="\t")
+        fp.write("\n")

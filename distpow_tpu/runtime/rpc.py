@@ -1,0 +1,234 @@
+"""Framed-JSON TCP RPC — the framework's DCN-level communication backend.
+
+Plays the role Go ``net/rpc`` plays in the reference (SURVEY.md section 2
+component 11): blocking unary calls (rpc.Client.Call,
+coordinator.go:195,226), async calls returning a completion handle
+(rpc.Client.Go, powlib/powlib.go:156, cmd/worker/main.go:35), one server
+servicing multiple listeners (the coordinator's segregated client/worker
+listeners, coordinator.go:334-351), and concurrent dispatch of requests.
+
+Wire format: 4-byte big-endian length prefix + UTF-8 JSON.
+Request  ``{"id": n, "method": "Service.Method", "params": {...}}``
+Response ``{"id": n, "result": ..., "error": null | str}``
+
+Byte fields travel as arrays of ints (the natural JSON form of the
+reference's ``[]uint8``); tracing tokens as base64 strings (see
+runtime/tracing.py).  Within a TPU pod the hot path never touches this
+transport — device fan-out rides ICI collectives (parallel/mesh_search.py);
+this backend carries only control-plane traffic between hosts, as the
+north-star design prescribes (BASELINE.json: "the coordinator/worker RPC
+boundary stays intact").
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, Optional, Tuple
+
+
+class RPCError(Exception):
+    pass
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("connection closed")
+        buf += part
+    return buf
+
+
+def _read_frame(sock: socket.socket) -> dict:
+    (length,) = struct.unpack(">I", _read_exact(sock, 4))
+    if length > 64 * 1024 * 1024:
+        raise RPCError(f"oversized frame: {length} bytes")
+    return json.loads(_read_exact(sock, length).decode())
+
+
+def _write_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
+    payload = json.dumps(obj).encode()
+    with lock:
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def split_addr(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class RPCServer:
+    """Multi-listener RPC server dispatching ``Service.Method`` requests.
+
+    Each connection gets a reader thread; each request is dispatched on its
+    own worker thread so slow handlers (the coordinator's blocking ``Mine``)
+    never head-of-line-block other requests on the same connection —
+    matching Go net/rpc's goroutine-per-request semantics.
+    """
+
+    def __init__(self):
+        self._services: Dict[str, object] = {}
+        self._listeners = []
+        self._threads = []
+        self._conns = set()
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+
+    def register(self, name: str, handler: object) -> None:
+        self._services[name] = handler
+
+    def listen(self, addr: str) -> str:
+        """Bind a listener; returns the bound address (resolves ':0')."""
+        host, port = split_addr(addr)
+        ls = socket.create_server((host, port), reuse_port=False)
+        self._listeners.append(ls)
+        bound = ls.getsockname()
+        return f"{host}:{bound[1]}"
+
+    def serve_in_background(self) -> None:
+        for ls in self._listeners:
+            t = threading.Thread(target=self._accept_loop, args=(ls,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _accept_loop(self, ls: socket.socket) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = ls.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._conn_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        try:
+            while True:
+                req = _read_frame(conn)
+                threading.Thread(
+                    target=self._dispatch,
+                    args=(conn, wlock, req),
+                    daemon=True,
+                ).start()
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            pass
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, wlock, req: dict) -> None:
+        rid = req.get("id")
+        try:
+            service_name, _, method_name = req["method"].partition(".")
+            service = self._services.get(service_name)
+            if service is None:
+                raise RPCError(f"unknown service {service_name!r}")
+            if method_name.startswith("_"):
+                raise RPCError(f"method {method_name!r} is not exported")
+            method = getattr(service, method_name, None)
+            if method is None or not callable(method):
+                raise RPCError(f"unknown method {req['method']!r}")
+            result = method(req.get("params") or {})
+            resp = {"id": rid, "result": result, "error": None}
+        except Exception as exc:  # handler errors travel to the caller
+            resp = {"id": rid, "result": None, "error": f"{type(exc).__name__}: {exc}"}
+        try:
+            _write_frame(conn, resp, wlock)
+        except OSError:
+            pass
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        for ls in self._listeners:
+            try:
+                ls.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class RPCClient:
+    """Connection to one RPC server: blocking ``call`` and async ``go``."""
+
+    def __init__(self, addr: str, timeout: Optional[float] = 10.0):
+        self._sock = socket.create_connection(split_addr(addr), timeout=timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._plock = threading.Lock()
+        self._next_id = 0
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                resp = _read_frame(self._sock)
+                with self._plock:
+                    fut = self._pending.pop(resp.get("id"), None)
+                if fut is None:
+                    continue
+                if resp.get("error"):
+                    fut.set_exception(RPCError(resp["error"]))
+                else:
+                    fut.set_result(resp.get("result"))
+        except (ConnectionError, OSError, json.JSONDecodeError) as exc:
+            with self._plock:
+                pending, self._pending = self._pending, {}
+            err = exc if self._closed is False else ConnectionError("client closed")
+            for fut in pending.values():
+                if not fut.done():
+                    fut.set_exception(RPCError(str(err)))
+
+    def go(self, method: str, params: Optional[dict] = None) -> Future:
+        """Async call; resolves with the result (rpc.Client.Go role)."""
+        fut: Future = Future()
+        with self._plock:
+            self._next_id += 1
+            rid = self._next_id
+            self._pending[rid] = fut
+        try:
+            _write_frame(
+                self._sock,
+                {"id": rid, "method": method, "params": params or {}},
+                self._wlock,
+            )
+        except OSError as exc:
+            with self._plock:
+                self._pending.pop(rid, None)
+            fut.set_exception(RPCError(str(exc)))
+        return fut
+
+    def call(
+        self, method: str, params: Optional[dict] = None, timeout: Optional[float] = None
+    ) -> Any:
+        """Blocking call (rpc.Client.Call role)."""
+        return self.go(method, params).result(timeout=timeout)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,22 @@
+"""Test harness configuration.
+
+The test suite runs on a virtual 8-device CPU mesh (mirroring the
+reference's everything-on-localhost validation strategy, SURVEY.md
+section 4), so sharding/collective behavior is exercised without TPU
+hardware.  The container's sitecustomize pre-imports jax against the
+axon/TPU backend, so we flip the platform *before the first backend use*
+rather than via environment variables.
+
+Set DISTPOW_TEST_TPU=1 to run the suite on the real accelerator instead.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "")
+if os.environ.get("DISTPOW_TEST_TPU") != "1":
+    os.environ["XLA_FLAGS"] = (
+        os.environ["XLA_FLAGS"] + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

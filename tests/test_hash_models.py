@@ -1,0 +1,107 @@
+"""Hash-model correctness: JAX and pure-Python twins vs hashlib."""
+
+import hashlib
+import random
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distpow_tpu.models import md5_jax, sha256_jax
+from distpow_tpu.models.registry import MD5, SHA256, get_hash_model
+
+
+def pad_md5(message: bytes) -> bytes:
+    tail = message + b"\x80"
+    tail += b"\x00" * ((-len(tail) - 8) % 64)
+    tail += struct.pack("<Q", len(message) * 8)
+    return tail
+
+
+def pad_sha256(message: bytes) -> bytes:
+    tail = message + b"\x80"
+    tail += b"\x00" * ((-len(tail) - 8) % 64)
+    tail += struct.pack(">Q", len(message) * 8)
+    return tail
+
+
+def blocks_to_words(padded: bytes, order: str):
+    fmt = "<16I" if order == "little" else ">16I"
+    return [
+        list(struct.unpack(fmt, padded[i : i + 64]))
+        for i in range(0, len(padded), 64)
+    ]
+
+
+@pytest.mark.parametrize("length", [0, 1, 3, 8, 55, 56, 63, 64, 65, 120, 200])
+def test_md5_jax_vs_hashlib(length):
+    rng = random.Random(length)
+    msg = bytes(rng.randrange(256) for _ in range(length))
+    words = blocks_to_words(pad_md5(msg), "little")
+    state = md5_jax.md5_digest_words(words)
+    digest = b"".join(int(w).to_bytes(4, "little") for w in state)
+    assert digest == hashlib.md5(msg).digest()
+
+
+@pytest.mark.parametrize("length", [0, 1, 8, 55, 56, 64, 65, 130])
+def test_sha256_jax_vs_hashlib(length):
+    rng = random.Random(1000 + length)
+    msg = bytes(rng.randrange(256) for _ in range(length))
+    words = blocks_to_words(pad_sha256(msg), "big")
+    state = sha256_jax.sha256_digest_words(words)
+    digest = b"".join(int(w).to_bytes(4, "big") for w in state)
+    assert digest == hashlib.sha256(msg).digest()
+
+
+def test_md5_jax_vectorized_batch():
+    # the compression must vectorize over batch-shaped message words
+    rng = random.Random(7)
+    msgs = [bytes(rng.randrange(256) for _ in range(10)) for _ in range(33)]
+    word_batches = []
+    for m in msgs:
+        word_batches.append(blocks_to_words(pad_md5(m), "little")[0])
+    arr = np.array(word_batches, dtype=np.uint32)  # (33, 16)
+    words = [jnp.asarray(arr[:, i]) for i in range(16)]
+    state = md5_jax.md5_digest_words([words])
+    for j, m in enumerate(msgs):
+        digest = b"".join(int(w[j]).to_bytes(4, "little") for w in state)
+        assert digest == hashlib.md5(m).digest()
+
+
+@pytest.mark.parametrize("model,href", [(MD5, hashlib.md5), (SHA256, hashlib.sha256)])
+@pytest.mark.parametrize("length", [0, 5, 63, 64, 70, 128, 129])
+def test_py_twins_vs_hashlib(model, href, length):
+    rng = random.Random(length * 31)
+    msg = bytes(rng.randrange(256) for _ in range(length))
+    if model is MD5:
+        assert md5_jax.py_digest(msg) == href(msg).digest()
+    else:
+        assert sha256_jax.py_digest(msg) == href(msg).digest()
+
+
+def test_py_absorb_prefix_state():
+    # absorbing N full blocks then continuing must equal hashing the whole
+    # message — this is what lets long constant nonces run host-side
+    msg = bytes(range(256)) * 2  # 512 bytes = 8 blocks
+    state, rem, absorbed = md5_jax.py_absorb(msg[:130])
+    assert absorbed == 128 and rem == msg[128:130]
+    # continue: tail = rem + suffix and padding with total length
+    suffix = b"hello"
+    total = msg[:130] + suffix
+    tail = rem + suffix + b"\x80"
+    tail += b"\x00" * ((-len(tail) - 8) % 64)
+    tail += struct.pack("<Q", len(total) * 8)
+    for i in range(0, len(tail), 64):
+        state = md5_jax.py_compress(state, tail[i : i + 64])
+    digest = b"".join(w.to_bytes(4, "little") for w in state)
+    assert digest == hashlib.md5(total).digest()
+
+
+def test_registry():
+    assert get_hash_model("md5") is MD5
+    assert get_hash_model("SHA256") is SHA256
+    assert MD5.max_difficulty == 32
+    assert SHA256.max_difficulty == 64
+    with pytest.raises(ValueError):
+        get_hash_model("sha1024")

@@ -1,0 +1,124 @@
+"""Ops-layer tests: packing, difficulty masks, fused search step."""
+
+import hashlib
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distpow_tpu.models import puzzle
+from distpow_tpu.models.registry import MD5, SHA256
+from distpow_tpu.ops.difficulty import meets_difficulty, nibble_masks
+from distpow_tpu.ops.packing import build_tail_spec, make_words, pack_reference_bytes
+from distpow_tpu.ops.search_step import (
+    SENTINEL,
+    build_search_step,
+    flat_to_candidate,
+)
+
+
+def digest_of(spec, model, tb, chunk):
+    state = spec.init_state
+    for b in range(spec.n_blocks):
+        words = make_words(spec, jnp.uint32(tb), jnp.uint32(chunk))[b]
+        state = model.compress(state, words)
+    return b"".join(int(w).to_bytes(4, model.word_byteorder) for w in state)
+
+
+@pytest.mark.parametrize("model", [MD5, SHA256])
+@pytest.mark.parametrize("nonce_len", [0, 4, 20, 54, 55, 63, 64, 65, 130])
+@pytest.mark.parametrize("width", [0, 1, 3, 4])
+def test_packing_matches_hashlib(model, nonce_len, width):
+    rng = random.Random(nonce_len * 7 + width)
+    nonce = bytes(rng.randrange(256) for _ in range(nonce_len))
+    spec = build_tail_spec(nonce, width, model)
+    for _ in range(3):
+        tb = rng.randrange(256)
+        chunk = rng.randrange(256 ** width) if width else 0
+        msg = pack_reference_bytes(nonce, tb, chunk, width)
+        expect = model.hashlib_new()
+        expect.update(msg)
+        assert digest_of(spec, model, tb, chunk) == expect.digest()
+
+
+def test_packing_extra_const_chunk():
+    # width > 4 support: high chunk bytes folded into the constant template
+    nonce = b"\x01\x02\x03\x04"
+    extra = b"\x09\x02"
+    spec = build_tail_spec(nonce, 4, MD5, extra_const_chunk=extra)
+    msg = pack_reference_bytes(nonce, 7, 0xDEADBEEF, 4, extra)
+    assert digest_of(spec, MD5, 7, 0xDEADBEEF) == hashlib.md5(msg).digest()
+    assert len(msg) == 4 + 1 + 4 + 2
+
+
+@pytest.mark.parametrize("model", [MD5, SHA256])
+def test_nibble_masks_vs_oracle(model):
+    rng = random.Random(42)
+    for _ in range(300):
+        digest = bytes(
+            rng.choice([0, 0, rng.randrange(256)])
+            for _ in range(model.digest_bytes)
+        )
+        words = tuple(
+            jnp.uint32(
+                int.from_bytes(digest[4 * i : 4 * i + 4], model.word_byteorder)
+            )
+            for i in range(model.digest_words)
+        )
+        true_k = puzzle.count_trailing_zero_nibbles(digest)
+        for k in (0, 1, true_k, true_k + 1, model.max_difficulty):
+            if k > model.max_difficulty:
+                with pytest.raises(ValueError):
+                    nibble_masks(k, model)
+                continue
+            ok = bool(meets_difficulty(words, nibble_masks(k, model)))
+            assert ok == (true_k >= k), (digest.hex(), k, true_k)
+
+
+def test_search_step_finds_reference_first_match():
+    nonce = b"\x01\x02\x03\x04"
+    difficulty = 2
+    tbs = list(range(256))
+    # oracle: first match in reference enumeration order within width<=2
+    oracle = puzzle.python_search(nonce, difficulty, tbs)
+    assert oracle is not None
+
+    # width-0 step
+    step0 = build_search_step(nonce, 0, difficulty, 0, 256, 1, MD5)
+    f0 = int(step0(jnp.uint32(0)))
+    # width-1 step covering chunks [1, 256)
+    step1 = build_search_step(nonce, 1, difficulty, 0, 256, 255, MD5)
+    f1 = int(step1(jnp.uint32(1)))
+
+    if f0 != SENTINEL:
+        chunk, tb = flat_to_candidate(f0, 0, 0, 256)
+        secret = bytes([tb])
+    else:
+        assert f1 != SENTINEL
+        chunk, tb = flat_to_candidate(f1, 1, 0, 256)
+        secret = bytes([tb]) + puzzle.int_to_chunk(chunk)
+    assert secret == oracle
+
+
+def test_search_step_no_false_positives_at_high_difficulty():
+    step = build_search_step(b"\x05\x06", 1, 30, 0, 256, 16, MD5)
+    assert int(step(jnp.uint32(1))) == SENTINEL
+
+
+def test_search_step_sha256():
+    nonce = b"\xaa"
+    tbs = list(range(256))
+    oracle = puzzle.python_search(nonce, 2, tbs, algo="sha256")
+    found = None
+    step0 = build_search_step(nonce, 0, 2, 0, 256, 1, SHA256)
+    f = int(step0(jnp.uint32(0)))
+    if f != SENTINEL:
+        found = bytes([f % 256])
+    else:
+        step1 = build_search_step(nonce, 1, 2, 0, 256, 255, SHA256)
+        f = int(step1(jnp.uint32(1)))
+        assert f != SENTINEL
+        chunk, tb = flat_to_candidate(f, 1, 0, 256)
+        found = bytes([tb]) + puzzle.int_to_chunk(chunk)
+    assert found == oracle

@@ -1,0 +1,68 @@
+"""Partition algebra tests (coordinator.go:326, worker.go:301-316)."""
+
+import pytest
+
+from distpow_tpu.parallel import partition
+
+
+def test_worker_bits_matches_go_truncation():
+    assert partition.worker_bits(1) == 0
+    assert partition.worker_bits(2) == 1
+    assert partition.worker_bits(3) == 1  # uint(log2(3)) truncates
+    assert partition.worker_bits(4) == 2
+    assert partition.worker_bits(8) == 3
+    with pytest.raises(ValueError):
+        partition.worker_bits(0)
+
+
+def test_remainder_bits():
+    assert partition.remainder_bits(0) == 8
+    assert partition.remainder_bits(2) == 6
+    assert partition.remainder_bits(8) == 0
+    assert partition.remainder_bits(9) == 8  # the % 9 quirk (worker.go:302)
+
+
+def test_single_worker_owns_all_first_bytes():
+    tbs = partition.thread_bytes(0, partition.worker_bits(1))
+    assert tbs == list(range(256))
+
+
+def test_power_of_two_partition_is_disjoint_cover():
+    n = 4
+    bits = partition.worker_bits(n)
+    all_bytes = []
+    for wb in range(n):
+        tbs = partition.thread_bytes(wb, bits)
+        assert len(tbs) == 64
+        assert tbs == list(range(wb * 64, (wb + 1) * 64))
+        all_bytes.extend(tbs)
+    assert sorted(all_bytes) == list(range(256))
+
+
+def test_non_power_of_two_overlaps_but_covers():
+    # reference quirk: floor(log2(3)) = 1, worker 2's prefix wraps onto
+    # worker 0's shard — full coverage with duplication, never a gap
+    n = 3
+    bits = partition.worker_bits(n)
+    shards = [partition.thread_bytes(wb, bits) for wb in range(n)]
+    assert shards[0] == list(range(0, 128))
+    assert shards[1] == list(range(128, 256))
+    assert shards[2] == list(range(0, 128))  # wrapped duplicate
+    covered = set()
+    for s in shards:
+        covered.update(s)
+    assert covered == set(range(256))
+
+
+def test_split_thread_bytes():
+    tbs = list(range(64, 128))
+    shards = partition.split_thread_bytes(tbs, 4)
+    assert [len(s) for s in shards] == [16, 16, 16, 16]
+    assert sum(shards, []) == tbs
+    # uneven split stays contiguous and covers
+    shards = partition.split_thread_bytes(list(range(10)), 3)
+    assert [len(s) for s in shards] == [4, 3, 3]
+    assert sum(shards, []) == list(range(10))
+    # more shards than bytes -> empties at the tail
+    shards = partition.split_thread_bytes([7], 3)
+    assert shards == [[7], [], []]

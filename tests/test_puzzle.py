@@ -1,0 +1,116 @@
+"""Behavioral contract tests for the core puzzle semantics.
+
+Pins the framework against the reference's exact semantics
+(worker.go:234-256, 301-319, 346-356) using hashlib as the oracle and a
+line-for-line-equivalent reimplementation of the chunk counter walk.
+"""
+
+import hashlib
+
+import pytest
+
+from distpow_tpu.models import puzzle
+
+
+def test_trailing_zero_nibbles_matches_hex_string():
+    # the raw-digest nibble count must equal counting '0' chars of the hex
+    # encoding, the reference's definition (worker.go:246-256, 354-356)
+    import random
+
+    rng = random.Random(0)
+    for _ in range(2000):
+        digest = bytes(rng.randrange(256) for _ in range(16))
+        expect = puzzle.count_trailing_zero_chars(digest.hex())
+        assert puzzle.count_trailing_zero_nibbles(digest) == expect
+    # crafted edges
+    assert puzzle.count_trailing_zero_nibbles(b"\x00" * 16) == 32
+    assert puzzle.count_trailing_zero_nibbles(b"\x01" + b"\x00" * 15) == 30
+    assert puzzle.count_trailing_zero_nibbles(b"\xff" * 15 + b"\x10") == 1
+    assert puzzle.count_trailing_zero_nibbles(b"\xff" * 15 + b"\x01") == 0
+    assert puzzle.count_trailing_zero_nibbles(b"\xff" * 16) == 0
+
+
+def test_check_secret_against_hashlib():
+    nonce, secret = b"\x01\x02\x03\x04", b"\x2a\x07"
+    hexd = hashlib.md5(nonce + secret).hexdigest()
+    k = puzzle.count_trailing_zero_chars(hexd)
+    assert puzzle.check_secret(nonce, secret, k)
+    assert not puzzle.check_secret(nonce, secret, k + 1)
+    assert puzzle.check_secret(nonce, secret, 0)
+
+
+def reference_next_chunk(chunk: bytearray) -> bytearray:
+    """Direct transliteration of the counter semantics (worker.go:234-244)
+    used as an independent oracle for the int<->chunk bijection."""
+    for i in range(len(chunk)):
+        if chunk[i] == 0xFF:
+            chunk[i] = 0
+        else:
+            chunk[i] += 1
+            return chunk
+    chunk.append(1)
+    return chunk
+
+
+def test_chunk_counter_is_minimal_little_endian_integers():
+    chunk = bytearray()
+    for n in range(1, 70000):
+        chunk = reference_next_chunk(chunk)
+        assert bytes(chunk) == puzzle.int_to_chunk(n), n
+        assert puzzle.chunk_to_int(bytes(chunk)) == n
+    # width transitions
+    assert puzzle.int_to_chunk(0) == b""
+    assert puzzle.int_to_chunk(255) == b"\xff"
+    assert puzzle.int_to_chunk(256) == b"\x00\x01"
+    assert puzzle.int_to_chunk(65535) == b"\xff\xff"
+    assert puzzle.int_to_chunk(65536) == b"\x00\x00\x01"
+    assert puzzle.chunk_width(0) == 0
+    assert puzzle.chunk_width(255) == 1
+    assert puzzle.chunk_width(256) == 2
+
+
+def test_iter_candidates_reference_order():
+    # for each chunk all thread bytes are tried before the chunk advances
+    # (worker.go:318-399, chunk starts empty)
+    tbs = [4, 5]
+    it = puzzle.iter_candidates(tbs)
+    got = [next(it) for _ in range(8)]
+    assert got == [
+        (0, 4, b"\x04"),
+        (0, 5, b"\x05"),
+        (1, 4, b"\x04\x01"),
+        (1, 5, b"\x05\x01"),
+        (2, 4, b"\x04\x02"),
+        (2, 5, b"\x05\x02"),
+        (3, 4, b"\x04\x03"),
+        (3, 5, b"\x05\x03"),
+    ]
+
+
+def test_python_search_finds_first_in_reference_order():
+    nonce = b"\x01\x02\x03\x04"
+    tbs = list(range(256))
+    secret = puzzle.python_search(nonce, 2, tbs)
+    assert secret is not None
+    assert puzzle.check_secret(nonce, secret, 2)
+    # verify firstness: no earlier candidate solves it
+    for _, _, cand in puzzle.iter_candidates(tbs):
+        if cand == secret:
+            break
+        assert not puzzle.check_secret(nonce, cand, 2)
+
+
+def test_python_search_cancel_and_budget():
+    nonce = b"\x00"
+    assert puzzle.python_search(nonce, 30, [0], max_candidates=100) is None
+    assert (
+        puzzle.python_search(nonce, 30, [0], cancel_check=lambda: True) is None
+    )
+
+
+def test_sha256_pluggable():
+    nonce, secret = b"\xaa\xbb", b"\x01"
+    hexd = hashlib.sha256(nonce + secret).hexdigest()
+    k = puzzle.count_trailing_zero_chars(hexd)
+    assert puzzle.check_secret(nonce, secret, k, algo="sha256")
+    assert not puzzle.check_secret(nonce, secret, k + 1, algo="sha256")

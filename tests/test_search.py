@@ -1,0 +1,132 @@
+"""Search-driver tests: single-device and mesh, vs the python oracle."""
+
+import threading
+
+import jax
+import pytest
+
+from distpow_tpu.models import puzzle
+from distpow_tpu.models.registry import SHA256
+from distpow_tpu.parallel import partition
+from distpow_tpu.parallel.mesh_search import make_mesh, search_mesh
+from distpow_tpu.parallel.search import search
+
+
+NONCES = [b"\x01\x02\x03\x04", b"\x02\x02\x02\x02", b"\xfe\xff"]
+
+
+@pytest.mark.parametrize("nonce", NONCES)
+@pytest.mark.parametrize("difficulty", [1, 2, 3])
+def test_search_matches_python_oracle_full_range(nonce, difficulty):
+    tbs = list(range(256))
+    oracle = puzzle.python_search(nonce, difficulty, tbs)
+    got = search(nonce, difficulty, tbs, batch_size=1 << 14)
+    assert got is not None
+    assert got.secret == oracle
+    assert puzzle.check_secret(nonce, got.secret, difficulty)
+
+
+def test_search_sub_partition():
+    # a single worker's shard in a 4-worker config (worker.go:301-316)
+    nonce = b"\x05\x06\x07\x08"
+    bits = partition.worker_bits(4)
+    tbs = partition.thread_bytes(2, bits)
+    oracle = puzzle.python_search(nonce, 2, tbs)
+    got = search(nonce, 2, tbs, batch_size=1 << 13)
+    assert got is not None and got.secret == oracle
+    assert got.secret[0] in tbs
+
+
+def test_search_difficulty4_deep():
+    # difficulty 4 typically needs tens of thousands of candidates; pushes
+    # into width >= 2 chunks
+    nonce = b"\x11\x22\x33\x44"
+    tbs = list(range(256))
+    got = search(nonce, 4, tbs, batch_size=1 << 16)
+    assert got is not None
+    assert puzzle.check_secret(nonce, got.secret, 4)
+    oracle = puzzle.python_search(nonce, 4, tbs)
+    assert got.secret == oracle
+
+
+def test_search_single_thread_byte():
+    # tb_count == 1 exercises the degenerate lane mapping
+    nonce = b"\x09"
+    got = search(nonce, 2, [7], batch_size=1 << 12)
+    oracle = puzzle.python_search(nonce, 2, [7])
+    assert got is not None and got.secret == oracle
+    assert got.secret[0] == 7
+
+
+def test_search_cancellation():
+    ev = threading.Event()
+    ev.set()
+    got = search(b"\x01", 30, list(range(256)), cancel_check=ev.is_set)
+    assert got is None
+
+
+def test_search_max_hashes_budget():
+    got = search(
+        b"\x01", 30, list(range(256)), batch_size=1 << 12, max_hashes=1 << 14
+    )
+    assert got is None
+
+
+def test_search_unsatisfiable_difficulty_returns_on_cancel():
+    got = search(b"\x01", 33, list(range(256)), cancel_check=lambda: True)
+    assert got is None
+    got = search(b"\x01", 33, list(range(256)), max_hashes=100)
+    assert got is None
+
+
+def test_search_sha256_model():
+    nonce = b"\x0a\x0b"
+    tbs = list(range(256))
+    oracle = puzzle.python_search(nonce, 2, tbs, algo="sha256")
+    got = search(nonce, 2, tbs, model=SHA256, batch_size=1 << 13)
+    assert got is not None and got.secret == oracle
+
+
+def test_search_long_nonce_multi_block():
+    # nonce longer than one hash block: constant blocks absorb host-side
+    nonce = bytes(range(256))[:100]
+    tbs = list(range(256))
+    oracle = puzzle.python_search(nonce, 2, tbs)
+    got = search(nonce, 2, tbs, batch_size=1 << 13)
+    assert got is not None and got.secret == oracle
+
+
+@pytest.mark.parametrize("difficulty", [2, 3])
+def test_mesh_search_matches_single_device(difficulty):
+    nonce = b"\x01\x02\x03\x04"
+    tbs = list(range(256))
+    mesh = make_mesh(jax.devices())
+    oracle = puzzle.python_search(nonce, difficulty, tbs)
+    got = search_mesh(
+        nonce, difficulty, tbs, mesh=mesh, batch_size=1 << 14
+    )
+    assert got is not None
+    assert got.secret == oracle
+
+
+def test_mesh_search_sub_partition_and_chunk_split():
+    mesh = make_mesh(jax.devices())
+    nonce = b"\x03\x01\x04\x01"
+    # tb-split: 64 tbs over 8 devices
+    tbs = partition.thread_bytes(1, 2)
+    oracle = puzzle.python_search(nonce, 2, tbs)
+    got = search_mesh(nonce, 2, tbs, mesh=mesh, batch_size=1 << 13)
+    assert got is not None and got.secret == oracle
+    # chunk-split: fewer tbs than devices
+    tbs = [5, 6, 7]
+    oracle = puzzle.python_search(nonce, 2, tbs)
+    got = search_mesh(nonce, 2, tbs, mesh=mesh, batch_size=1 << 13)
+    assert got is not None and got.secret == oracle
+
+
+def test_mesh_search_cancellation():
+    mesh = make_mesh(jax.devices())
+    got = search_mesh(
+        b"\x01", 30, list(range(256)), mesh=mesh, cancel_check=lambda: True
+    )
+    assert got is None
