@@ -1,0 +1,31 @@
+from . import actions
+from .cache import CacheEntry, ResultCache
+from .config import (
+    ClientConfig,
+    CoordinatorConfig,
+    TracingServerConfig,
+    WorkerConfig,
+    read_json_config,
+    write_json_config,
+)
+from .rpc import RPCClient, RPCError, RPCServer
+from .trace_server import TracingServer
+from .tracing import (
+    FileSink,
+    MemorySink,
+    TCPSink,
+    Trace,
+    Tracer,
+    decode_token,
+    encode_token,
+    make_tracer,
+)
+
+__all__ = [
+    "actions", "CacheEntry", "ResultCache",
+    "ClientConfig", "CoordinatorConfig", "TracingServerConfig", "WorkerConfig",
+    "read_json_config", "write_json_config",
+    "RPCClient", "RPCError", "RPCServer", "TracingServer",
+    "FileSink", "MemorySink", "TCPSink", "Trace", "Tracer",
+    "decode_token", "encode_token", "make_tracer",
+]

@@ -1,0 +1,331 @@
+"""Runtime-layer tests: tracing, dominance cache, RPC, config, trace server."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from distpow_tpu.runtime import (
+    MemorySink,
+    RPCClient,
+    RPCError,
+    RPCServer,
+    ResultCache,
+    Tracer,
+    TracingServer,
+    TracingServerConfig,
+)
+from distpow_tpu.runtime.actions import (
+    CacheAdd,
+    CacheHit,
+    CacheMiss,
+    CacheRemove,
+    CoordinatorMine,
+    WorkerResult,
+)
+from distpow_tpu.runtime.config import (
+    ClientConfig,
+    CoordinatorConfig,
+    WorkerConfig,
+    read_json_config,
+    write_json_config,
+)
+from distpow_tpu.runtime.tracing import TCPSink
+
+
+# --- tracing ----------------------------------------------------------------
+
+def test_trace_actions_and_vector_clocks():
+    sink = MemorySink()
+    tracer = Tracer("client1", sink)
+    trace = tracer.create_trace()
+    trace.record_action(CoordinatorMine(nonce=b"\x01\x02", num_trailing_zeros=3))
+    trace.record_action(
+        WorkerResult(nonce=b"\x01\x02", num_trailing_zeros=3, worker_byte=0, secret=b"\x07")
+    )
+    acts = sink.actions(identity="client1")
+    assert [a[1] for a in acts] == ["CoordinatorMine", "WorkerResult"]
+    assert acts[0][2]["nonce"] == [1, 2]
+    assert acts[1][2]["secret"] == [7]
+    # vector clock strictly increases on the recording identity
+    clocks = [e["vc"]["client1"] for e in sink.events if e["type"] == "action"]
+    assert clocks == sorted(clocks) and len(set(clocks)) == len(clocks)
+
+
+def test_token_passing_stitches_happens_before():
+    sink_a, sink_b = MemorySink(), MemorySink()
+    a = Tracer("nodeA", sink_a)
+    b = Tracer("nodeB", sink_b)
+    ta = a.create_trace()
+    ta.record_action(CoordinatorMine(nonce=b"\x05", num_trailing_zeros=1))
+    token = ta.generate_token()
+
+    tb = b.receive_token(token)
+    assert tb.trace_id == ta.trace_id  # same causal trace across nodes
+    tb.record_action(WorkerResult(nonce=b"\x05", num_trailing_zeros=1, worker_byte=0, secret=b""))
+    # B's clock dominates A's at token-generation time (happens-before)
+    b_event = [e for e in sink_b.events if e["type"] == "action"][0]
+    a_token_event = [e for e in sink_a.events if e["type"] == "generate_token"][0]
+    for ident, clk in a_token_event["vc"].items():
+        assert b_event["vc"].get(ident, 0) >= clk
+    assert b_event["vc"]["nodeB"] >= 1
+
+    # token round-trips back: A merges B's clock
+    token_b = tb.generate_token()
+    ta2 = a.receive_token(token_b)
+    assert ta2.trace_id == ta.trace_id
+    a_after = [e for e in sink_a.events if e["type"] == "receive_token"][0]
+    assert a_after["vc"]["nodeB"] >= 1
+
+
+def test_tracer_thread_safety():
+    sink = MemorySink()
+    tracer = Tracer("node", sink)
+    trace = tracer.create_trace()
+
+    def hammer():
+        for _ in range(200):
+            trace.record_action(CacheMiss(nonce=b"\x01", num_trailing_zeros=1))
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    clocks = [e["vc"]["node"] for e in sink.events]
+    assert len(clocks) == 1600
+    assert len(set(clocks)) == 1600  # every tick unique under contention
+
+
+# --- dominance cache (coordinator.go:390-473 / worker.go:423-506) -----------
+
+@pytest.fixture
+def traced_cache():
+    sink = MemorySink()
+    tracer = Tracer("node", sink)
+    return ResultCache(), tracer.create_trace(), sink
+
+
+def names(sink):
+    return [a[1] for a in sink.actions()]
+
+
+def test_cache_miss_then_add_then_hit(traced_cache):
+    cache, trace, sink = traced_cache
+    assert cache.get(b"\x01", 3, trace) is None
+    cache.add(b"\x01", 3, b"\xaa", trace)
+    assert cache.get(b"\x01", 3, trace) == b"\xaa"
+    assert cache.get(b"\x01", 2, trace) == b"\xaa"  # dominance: 3 >= 2
+    assert cache.get(b"\x01", 4, trace) is None     # 3 < 4
+    assert names(sink) == ["CacheMiss", "CacheAdd", "CacheHit", "CacheHit", "CacheMiss"]
+
+
+def test_cache_replace_on_higher_difficulty(traced_cache):
+    cache, trace, sink = traced_cache
+    cache.add(b"\x01", 3, b"\xaa", trace)
+    cache.add(b"\x01", 5, b"\x01", trace)  # higher zeros replaces
+    assert cache.get(b"\x01", 5, trace) == b"\x01"
+    assert names(sink) == ["CacheAdd", "CacheRemove", "CacheAdd", "CacheHit"]
+    # the remove logs the OLD entry (coordinator.go:438-442)
+    remove = sink.actions()[1][2]
+    assert remove["num_trailing_zeros"] == 3 and remove["secret"] == [0xAA]
+
+
+def test_cache_replace_on_lexicographically_greater_secret(traced_cache):
+    cache, trace, sink = traced_cache
+    cache.add(b"\x01", 3, b"\x10", trace)
+    cache.add(b"\x01", 3, b"\x20", trace)      # same zeros, greater secret
+    assert cache.get(b"\x01", 3, trace) == b"\x20"
+    cache.add(b"\x01", 3, b"\x15", trace)      # dominated: no-op, no actions
+    assert cache.get(b"\x01", 3, trace) == b"\x20"
+    assert names(sink).count("CacheRemove") == 1
+
+
+def test_cache_dominated_insert_is_silent(traced_cache):
+    cache, trace, sink = traced_cache
+    cache.add(b"\x01", 5, b"\xaa", trace)
+    before = names(sink)
+    assert cache.add(b"\x01", 3, b"\xbb", trace) is False
+    assert names(sink) == before
+
+
+def test_cache_property_convergence():
+    """Dominance order makes replicas converge regardless of arrival order."""
+    import itertools
+    import random
+
+    updates = [(2, b"\x05"), (3, b"\x01"), (3, b"\x07"), (1, b"\xff"), (3, b"\x02")]
+    finals = set()
+    for perm in itertools.permutations(updates):
+        cache = ResultCache()
+        for ntz, sec in perm:
+            cache.add(b"\x09", ntz, sec, None)
+        e = cache.peek(b"\x09")
+        finals.add((e.num_trailing_zeros, e.secret))
+    assert finals == {(3, b"\x07")}
+
+
+# --- RPC --------------------------------------------------------------------
+
+class EchoService:
+    def __init__(self):
+        self.slow_started = threading.Event()
+
+    def Echo(self, params):
+        return {"echo": params}
+
+    def Add(self, params):
+        return {"sum": params["a"] + params["b"]}
+
+    def Boom(self, params):
+        raise ValueError("boom")
+
+    def Slow(self, params):
+        self.slow_started.set()
+        time.sleep(params.get("delay", 0.3))
+        return {"done": True}
+
+    def _private(self, params):
+        return {"leak": True}
+
+
+@pytest.fixture
+def rpc_pair():
+    srv = RPCServer()
+    svc = EchoService()
+    srv.register("Echo", svc)
+    addr = srv.listen("127.0.0.1:0")
+    srv.serve_in_background()
+    cli = RPCClient(addr)
+    yield srv, cli, svc
+    cli.close()
+    srv.shutdown()
+
+
+def test_rpc_roundtrip(rpc_pair):
+    _, cli, _ = rpc_pair
+    assert cli.call("Echo.Add", {"a": 2, "b": 40}) == {"sum": 42}
+    assert cli.call("Echo.Echo", {"nonce": [1, 2, 3]}) == {"echo": {"nonce": [1, 2, 3]}}
+
+
+def test_rpc_error_propagates(rpc_pair):
+    _, cli, _ = rpc_pair
+    with pytest.raises(RPCError, match="boom"):
+        cli.call("Echo.Boom", {})
+    with pytest.raises(RPCError, match="unknown method"):
+        cli.call("Echo.Nope", {})
+    with pytest.raises(RPCError, match="unknown service"):
+        cli.call("Nope.Echo", {})
+    with pytest.raises(RPCError, match="not exported"):
+        cli.call("Echo._private", {})
+
+
+def test_rpc_async_go_and_concurrency(rpc_pair):
+    _, cli, svc = rpc_pair
+    # a slow call must not head-of-line-block fast ones on the same conn
+    slow = cli.go("Echo.Slow", {"delay": 0.5})
+    svc.slow_started.wait(2)
+    t0 = time.time()
+    assert cli.call("Echo.Add", {"a": 1, "b": 1}) == {"sum": 2}
+    assert time.time() - t0 < 0.4
+    assert slow.result(2) == {"done": True}
+
+
+def test_rpc_many_concurrent_calls(rpc_pair):
+    _, cli, _ = rpc_pair
+    futs = [cli.go("Echo.Add", {"a": i, "b": i}) for i in range(100)]
+    assert [f.result(5)["sum"] for f in futs] == [2 * i for i in range(100)]
+
+
+def test_rpc_multiple_listeners():
+    # one server on two listeners: the coordinator's segregated
+    # client-facing and worker-facing endpoints (coordinator.go:334-351)
+    srv = RPCServer()
+    srv.register("Echo", EchoService())
+    a1 = srv.listen("127.0.0.1:0")
+    a2 = srv.listen("127.0.0.1:0")
+    assert a1 != a2
+    srv.serve_in_background()
+    c1, c2 = RPCClient(a1), RPCClient(a2)
+    assert c1.call("Echo.Add", {"a": 1, "b": 2}) == {"sum": 3}
+    assert c2.call("Echo.Add", {"a": 3, "b": 4}) == {"sum": 7}
+    c1.close(); c2.close(); srv.shutdown()
+
+
+# --- config -----------------------------------------------------------------
+
+def test_config_roundtrip(tmp_path):
+    cfg = WorkerConfig(
+        WorkerID="worker7",
+        ListenAddr="127.0.0.1:1234",
+        CoordAddr="127.0.0.1:999",
+        TracerServerAddr="127.0.0.1:888",
+        Backend="jax-mesh",
+        HashModel="sha256",
+        BatchSize=1 << 16,
+    )
+    p = tmp_path / "worker.json"
+    write_json_config(p, cfg)
+    loaded = read_json_config(p, WorkerConfig)
+    assert loaded == cfg
+
+
+def test_config_reads_reference_format(tmp_path):
+    # the reference's exact JSON shape loads unchanged (config/*.json)
+    p = tmp_path / "coord.json"
+    p.write_text(json.dumps({
+        "ClientAPIListenAddr": ":38888",
+        "WorkerAPIListenAddr": ":48888",
+        "Workers": [":20000", ":20001"],
+        "TracerServerAddr": ":58888",
+        "TracerSecret": "",
+        "SomeUnknownField": 7,
+    }))
+    cfg = read_json_config(p, CoordinatorConfig)
+    assert cfg.Workers == [":20000", ":20001"]
+    assert cfg.TracerSecret == b""
+    cl = tmp_path / "client.json"
+    cl.write_text(json.dumps({"ClientID": "client2", "CoordAddr": ":38888",
+                              "TracerServerAddr": ":58888", "TracerSecret": ""}))
+    ccfg = read_json_config(cl, ClientConfig)
+    assert ccfg.ClientID == "client2" and ccfg.ChCapacity == 10
+
+
+# --- tracing server ---------------------------------------------------------
+
+def test_tracing_server_end_to_end(tmp_path):
+    out = tmp_path / "trace_output.log"
+    shiviz = tmp_path / "shiviz_output.log"
+    server = TracingServer(TracingServerConfig(
+        ServerBind="127.0.0.1:0",
+        Secret=b"s3cret",
+        OutputFile=str(out),
+        ShivizOutputFile=str(shiviz),
+    ))
+    addr = server.open()
+    server.accept_in_background()
+
+    tracer = Tracer("worker1", TCPSink(addr, b"s3cret"))
+    trace = tracer.create_trace()
+    trace.record_action(CoordinatorMine(nonce=b"\x01\x02", num_trailing_zeros=4))
+    trace.generate_token()
+    tracer.close()
+    time.sleep(0.3)
+
+    human = out.read_text()
+    assert "[worker1]" in human and "CoordinatorMine" in human
+    assert f"TraceID={trace.trace_id}" in human
+    sv = shiviz.read_text()
+    assert sv.startswith("(?<host>")
+    assert "worker1 {" in sv and "CoordinatorMine" in sv
+
+    # wrong secret: events must NOT land
+    bad = Tracer("intruder", TCPSink(addr, b"wrong"))
+    t2 = bad.create_trace()
+    try:
+        t2.record_action(CacheMiss(nonce=b"\x01", num_trailing_zeros=1))
+    except OSError:
+        pass
+    time.sleep(0.3)
+    assert "intruder" not in out.read_text()
+    server.close()
