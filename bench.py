@@ -1,0 +1,155 @@
+"""Benchmark: MD5 proof-of-work search throughput on the local accelerator.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "MH/s", "vs_baseline": N}
+
+* ``value``: best sustained device throughput (MH/s/chip) of the fused
+  search step across the XLA and Pallas paths at difficulty 8 nibbles
+  (32 bits, BASELINE.md config 4's difficulty) on width-4 chunks.
+* ``vs_baseline``: ratio against a single CPU worker-equivalent — the
+  native C++ miner at one thread (a strictly-faster stand-in for the
+  reference's single-goroutine Go worker, BASELINE.md config 1; the Go
+  loop also pays per-candidate hex formatting, worker.go:354-355, so this
+  baseline is conservative).
+
+Details go to stderr; only the JSON line goes to stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def device_rate(step_builder, label: str, min_seconds: float = 2.0) -> float:
+    """Sustained candidates/sec of a step(chunk0)->uint32 launcher.
+
+    Adaptively scales the launch count until the timed window is at least
+    ``min_seconds`` so remote-tunnel dispatch jitter can't dominate.
+    """
+    import jax.numpy as jnp
+
+    step, batch = step_builder()
+    # warmup / compile
+    step(jnp.uint32(1 << 24)).block_until_ready()
+
+    iters = 8
+    while True:
+        t0 = time.time()
+        outs = [
+            step(jnp.uint32(((1 << 24) + i * batch) & 0xFFFFFFFF))
+            for i in range(iters)
+        ]
+        for o in outs:
+            o.block_until_ready()
+        dt = time.time() - t0
+        if dt >= min_seconds or iters >= 1 << 14:
+            break
+        iters = min(1 << 14, max(iters * 2, int(iters * min_seconds / max(dt, 1e-4)) + 1))
+    rate = batch * iters / dt
+    print(f"[bench] {label}: {rate / 1e6:.2f} MH/s "
+          f"({iters} x {batch} candidates in {dt:.3f}s)", file=sys.stderr)
+    return rate
+
+
+def main() -> None:
+    import jax
+
+    print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
+
+    from distpow_tpu.models.registry import get_hash_model
+    from distpow_tpu.ops.search_step import build_search_step
+
+    model = get_hash_model("md5")
+    nonce = b"\x01\x02\x03\x04"
+    difficulty = 8
+    chunks = 8192  # x 256 thread bytes = 2^21 candidates per launch
+
+    def xla_builder():
+        step = build_search_step(
+            nonce, 4, difficulty, 0, 256, chunks, model
+        )
+        return step, chunks * 256
+
+    rates = {"xla": device_rate(xla_builder, "xla fused step")}
+
+    try:
+        from distpow_tpu.ops.md5_pallas import build_pallas_search_step
+
+        def pallas_builder():
+            step = build_pallas_search_step(
+                nonce, 4, difficulty, 0, 256, chunks
+            )
+            return step, chunks * 256
+
+        rates["pallas"] = device_rate(pallas_builder, "pallas kernel")
+    except Exception as exc:  # pallas unsupported on this backend
+        print(f"[bench] pallas path unavailable: {exc}", file=sys.stderr)
+
+    best_label = max(rates, key=rates.get)
+    best = rates[best_label]
+
+    # sanity: a real end-to-end solve at difficulty 6 nibbles (24 bits,
+    # BASELINE.md config 3) — wall-clock includes driver + verification
+    try:
+        from distpow_tpu.models import puzzle
+        from distpow_tpu.parallel.search import search
+
+        t0 = time.time()
+        res = search(b"\x13\x57\x9b\xdf", 6, list(range(256)),
+                     batch_size=1 << 21)
+        dt = time.time() - t0
+        assert res is not None
+        assert puzzle.check_secret(b"\x13\x57\x9b\xdf", res.secret, 6)
+        print(f"[bench] e2e diff=24bit solve: secret={res.secret.hex()} "
+              f"after {res.hashes_tried / 1e6:.1f}M hashes in {dt:.2f}s "
+              f"({res.hashes_tried / dt / 1e6:.1f} MH/s incl. overhead)",
+              file=sys.stderr)
+    except Exception as exc:
+        print(f"[bench] e2e solve failed: {exc}", file=sys.stderr)
+
+    # CPU single-worker baseline (reference config 1 stand-in)
+    baseline = None
+    try:
+        from distpow_tpu.backends import native_miner
+
+        lib = native_miner.load_library()
+        import ctypes
+
+        tb = bytes(range(256))
+        hashes = ctypes.c_uint64(0)
+        secret = ctypes.create_string_buffer(16)
+        n = 1 << 21
+        t0 = time.time()
+        lib.distpow_search_range(
+            nonce, len(nonce), 32, tb, len(tb), 4, 1 << 24, n // 256,
+            1, None, ctypes.byref(hashes), secret,
+        )
+        dt = time.time() - t0
+        baseline = hashes.value / dt
+        print(f"[bench] native 1-thread CPU baseline: "
+              f"{baseline / 1e6:.2f} MH/s", file=sys.stderr)
+    except Exception as exc:
+        print(f"[bench] native baseline unavailable ({exc}); "
+              f"falling back to hashlib", file=sys.stderr)
+        import hashlib
+
+        t0 = time.time()
+        count = 200_000
+        for i in range(count):
+            hashlib.md5(nonce + i.to_bytes(5, "little")).digest()
+        baseline = count / (time.time() - t0)
+        print(f"[bench] hashlib CPU baseline: {baseline / 1e6:.2f} MH/s",
+              file=sys.stderr)
+
+    print(json.dumps({
+        "metric": f"MH/s/chip md5 pow search ({best_label} step, diff=32bits)",
+        "value": round(best / 1e6, 3),
+        "unit": "MH/s",
+        "vs_baseline": round(best / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

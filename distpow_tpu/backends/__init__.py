@@ -1,0 +1,132 @@
+"""Worker compute backends.
+
+The reference worker has exactly one compute path — a single-goroutine
+byte-at-a-time loop (worker.go:318-400).  Here the miner is a pluggable
+backend selected by ``WorkerConfig.Backend``:
+
+* ``python``   — hashlib loop, the CPU behavioral-parity baseline
+* ``jax``      — fused XLA search step on the default device (TPU when
+                 present), batched + pipelined (parallel/search.py)
+* ``jax-mesh`` — shard_map over all local devices, prefix->core
+                 (parallel/mesh_search.py)
+* ``pallas``   — hand-written TPU kernel for the MD5 hot op
+                 (ops/md5_pallas.py) behind the same driver
+* ``native``   — C++ miner via ctypes (backends/native/), the CPU
+                 performance path
+
+Every backend implements ``search(nonce, difficulty, thread_bytes,
+cancel_check) -> Optional[bytes]`` returning the first solving secret in
+reference enumeration order, or None when cancelled.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from ..models import puzzle
+from ..models.registry import get_hash_model
+
+
+class PythonBackend:
+    """Reference-parity CPU loop (worker.go:318-400 minus string formatting)."""
+
+    name = "python"
+
+    def __init__(self, hash_model: str = "md5", **_):
+        self.hash_model = hash_model
+
+    def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
+        return puzzle.python_search(
+            nonce,
+            difficulty,
+            thread_bytes,
+            algo=self.hash_model,
+            cancel_check=cancel_check,
+            cancel_poll_interval=1024,
+        )
+
+
+class JaxBackend:
+    """Single-device fused-step search (the TPU path)."""
+
+    name = "jax"
+
+    def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20, **_):
+        self.model = get_hash_model(hash_model)
+        self.batch_size = batch_size
+
+    def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
+        from ..parallel.search import search
+
+        res = search(
+            nonce,
+            difficulty,
+            thread_bytes,
+            model=self.model,
+            batch_size=self.batch_size,
+            cancel_check=cancel_check,
+        )
+        return None if res is None else res.secret
+
+
+class JaxMeshBackend:
+    """shard_map over the local device mesh (prefix -> core)."""
+
+    name = "jax-mesh"
+
+    def __init__(
+        self,
+        hash_model: str = "md5",
+        batch_size: int = 1 << 20,
+        mesh_devices: int = 0,
+        **_,
+    ):
+        self.model = get_hash_model(hash_model)
+        self.batch_size = batch_size
+        self.mesh_devices = mesh_devices
+        self._mesh = None
+
+    def _get_mesh(self):
+        if self._mesh is None:
+            import jax
+
+            from ..parallel.mesh_search import make_mesh
+
+            devs = jax.devices()
+            if self.mesh_devices:
+                devs = devs[: self.mesh_devices]
+            self._mesh = make_mesh(devs)
+        return self._mesh
+
+    def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
+        from ..parallel.mesh_search import search_mesh
+
+        res = search_mesh(
+            nonce,
+            difficulty,
+            thread_bytes,
+            mesh=self._get_mesh(),
+            model=self.model,
+            batch_size=self.batch_size,
+            cancel_check=cancel_check,
+        )
+        return None if res is None else res.secret
+
+
+def get_backend(name: str, **kwargs):
+    name = (name or "jax").lower()
+    if name == "python":
+        return PythonBackend(**kwargs)
+    if name == "jax":
+        return JaxBackend(**kwargs)
+    if name in ("jax-mesh", "mesh"):
+        return JaxMeshBackend(**kwargs)
+    if name == "pallas":
+        from .pallas_backend import PallasBackend
+
+        return PallasBackend(**kwargs)
+    if name == "native":
+        from .native_miner import NativeBackend
+
+        return NativeBackend(**kwargs)
+    raise ValueError(f"unknown worker backend {name!r}")

@@ -1,0 +1,270 @@
+// Native CPU miner for the distpow_tpu framework.
+//
+// Plays the role of the reference worker's hot loop (worker.go:318-400)
+// on the CPU path (BASELINE.md configs 1-2), with the two structural
+// inefficiencies called out in BASELINE.md fixed: no per-candidate hex
+// string formatting (the trailing-nibble check runs on the raw digest)
+// and optional multi-threaded range splitting instead of the reference's
+// single goroutine per worker.
+//
+// Exposed via a C ABI consumed through ctypes (backends/native_miner.py).
+// Candidate enumeration contract (models/puzzle.py): secret =
+// thread_byte ‖ chunk where chunk is the width-byte little-endian
+// encoding of a chunk integer; for each chunk all thread bytes are tried
+// in order (chunk-major, thread-byte-minor = reference order).
+//
+// MD5 implemented from the RFC 1321 specification (single translation
+// unit, no dependencies).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kInitState[4] = {0x67452301u, 0xefcdab89u, 0x98badcfeu,
+                                    0x10325476u};
+
+constexpr uint32_t kK[64] = {
+    0xd76aa478u, 0xe8c7b756u, 0x242070dbu, 0xc1bdceeeu, 0xf57c0fafu,
+    0x4787c62au, 0xa8304613u, 0xfd469501u, 0x698098d8u, 0x8b44f7afu,
+    0xffff5bb1u, 0x895cd7beu, 0x6b901122u, 0xfd987193u, 0xa679438eu,
+    0x49b40821u, 0xf61e2562u, 0xc040b340u, 0x265e5a51u, 0xe9b6c7aau,
+    0xd62f105du, 0x02441453u, 0xd8a1e681u, 0xe7d3fbc8u, 0x21e1cde6u,
+    0xc33707d6u, 0xf4d50d87u, 0x455a14edu, 0xa9e3e905u, 0xfcefa3f8u,
+    0x676f02d9u, 0x8d2a4c8au, 0xfffa3942u, 0x8771f681u, 0x6d9d6122u,
+    0xfde5380cu, 0xa4beea44u, 0x4bdecfa9u, 0xf6bb4b60u, 0xbebfbc70u,
+    0x289b7ec6u, 0xeaa127fau, 0xd4ef3085u, 0x04881d05u, 0xd9d4d039u,
+    0xe6db99e5u, 0x1fa27cf8u, 0xc4ac5665u, 0xf4292244u, 0x432aff97u,
+    0xab9423a7u, 0xfc93a039u, 0x655b59c3u, 0x8f0ccc92u, 0xffeff47du,
+    0x85845dd1u, 0x6fa87e4fu, 0xfe2ce6e0u, 0xa3014314u, 0x4e0811a1u,
+    0xf7537e82u, 0xbd3af235u, 0x2ad7d2bbu, 0xeb86d391u};
+
+constexpr int kS[64] = {7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22, 7,
+                        12, 17, 22, 5, 9,  14, 20, 5, 9,  14, 20, 5, 9,
+                        14, 20, 5,  9, 14, 20, 4, 11, 16, 23, 4, 11, 16,
+                        23, 4,  11, 16, 23, 4, 11, 16, 23, 6, 10, 15, 21,
+                        6,  10, 15, 21, 6,  10, 15, 21, 6, 10, 15, 21};
+
+inline uint32_t Rotl(uint32_t x, int s) { return (x << s) | (x >> (32 - s)); }
+
+// One MD5 block compression over a 64-byte block.
+void Compress(uint32_t state[4], const uint8_t block[64]) {
+  uint32_t m[16];
+  std::memcpy(m, block, 64);  // little-endian hosts only (x86/ARM LE)
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  for (int i = 0; i < 64; ++i) {
+    uint32_t f;
+    int g;
+    if (i < 16) {
+      f = (b & c) | (~b & d);
+      g = i;
+    } else if (i < 32) {
+      f = (d & b) | (~d & c);
+      g = (5 * i + 1) & 15;
+    } else if (i < 48) {
+      f = b ^ c ^ d;
+      g = (3 * i + 5) & 15;
+    } else {
+      f = c ^ (b | ~d);
+      g = (7 * i) & 15;
+    }
+    f += a + kK[i] + m[g];
+    a = d;
+    d = c;
+    c = b;
+    b += Rotl(f, kS[i]);
+  }
+  state[0] += a;
+  state[1] += b;
+  state[2] += c;
+  state[3] += d;
+}
+
+// Trailing zero nibbles of the 16-byte digest, scanned from the end:
+// low nibble of the last byte first (hex-string order).
+inline bool MeetsDifficulty(const uint8_t digest[16], uint32_t nibbles) {
+  uint32_t full = nibbles / 2;
+  for (uint32_t i = 0; i < full; ++i) {
+    if (digest[15 - i] != 0) return false;
+  }
+  if (nibbles & 1) {
+    if ((digest[15 - full] & 0x0f) != 0) return false;
+  }
+  return true;
+}
+
+struct SearchTask {
+  const uint8_t* nonce;
+  size_t nonce_len;
+  uint32_t difficulty;
+  const uint8_t* thread_bytes;
+  size_t n_tb;
+  uint32_t width;
+  uint64_t chunk_start;
+  uint64_t chunk_end;  // exclusive
+  const volatile int32_t* cancel_flag;
+};
+
+struct Found {
+  std::atomic<uint64_t> flat_index{UINT64_MAX};  // chunk_off * n_tb + tb_idx
+  std::atomic<int> any{0};
+};
+
+// Scan [chunk_lo, chunk_hi) in reference order; update `found` with the
+// minimum flat index seen.  Checks cancel/found every `poll` candidates.
+void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
+               Found* found, uint64_t* hashes_out) {
+  const size_t msg_len = t.nonce_len + 1 + t.width;
+  // Single-block fast path covers msg_len <= 55; longer prefixes use the
+  // generic multi-block path below.
+  uint8_t tail[128];
+  uint64_t hashes = 0;
+  const uint64_t poll = 4096;
+  uint64_t next_poll = poll;
+
+  // Precompute the constant prefix state for long messages.
+  uint32_t prefix_state[4];
+  std::memcpy(prefix_state, kInitState, sizeof(prefix_state));
+  size_t absorbed = (t.nonce_len / 64) * 64;
+  for (size_t off = 0; off < absorbed; off += 64) {
+    Compress(prefix_state, t.nonce + off);
+  }
+  const uint8_t* rem = t.nonce + absorbed;
+  const size_t rem_len = t.nonce_len - absorbed;
+  const size_t tail_content = rem_len + 1 + t.width;
+  const size_t tail_blocks = (tail_content + 1 + 8 + 63) / 64;
+  const size_t tail_len = tail_blocks * 64;
+
+  std::memset(tail, 0, sizeof(tail));
+  std::memcpy(tail, rem, rem_len);
+  tail[tail_content] = 0x80;
+  const uint64_t bitlen = static_cast<uint64_t>(msg_len) * 8;
+  for (int i = 0; i < 8; ++i) {
+    tail[tail_len - 8 + i] = static_cast<uint8_t>(bitlen >> (8 * i));
+  }
+
+  for (uint64_t chunk = chunk_lo; chunk < chunk_hi; ++chunk) {
+    // chunk bytes (little-endian, fixed width) land after the thread byte
+    for (uint32_t j = 0; j < t.width; ++j) {
+      tail[rem_len + 1 + j] = static_cast<uint8_t>(chunk >> (8 * j));
+    }
+    for (size_t ti = 0; ti < t.n_tb; ++ti) {
+      if (hashes >= next_poll) {
+        next_poll = hashes + poll;
+        if ((t.cancel_flag && *t.cancel_flag) ||
+            found->any.load(std::memory_order_relaxed)) {
+          *hashes_out += hashes;
+          return;
+        }
+      }
+      tail[rem_len] = t.thread_bytes[ti];
+      uint32_t state[4];
+      std::memcpy(state, prefix_state, sizeof(state));
+      for (size_t b = 0; b < tail_blocks; ++b) {
+        Compress(state, tail + 64 * b);
+      }
+      ++hashes;
+      uint8_t digest[16];
+      std::memcpy(digest, state, 16);
+      if (MeetsDifficulty(digest, t.difficulty)) {
+        const uint64_t flat =
+            (chunk - t.chunk_start) * t.n_tb + static_cast<uint64_t>(ti);
+        uint64_t cur = found->flat_index.load(std::memory_order_relaxed);
+        while (flat < cur && !found->flat_index.compare_exchange_weak(
+                                 cur, flat, std::memory_order_relaxed)) {
+        }
+        found->any.store(1, std::memory_order_relaxed);
+        *hashes_out += hashes;
+        return;
+      }
+    }
+  }
+  *hashes_out += hashes;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Searches chunk integers [chunk_start, chunk_start + chunk_count) over
+// the given thread bytes at the given chunk byte width.
+//
+// Returns 1 if a secret was found (written to out_secret, length
+// 1 + width), 0 if the range was exhausted, -1 if cancelled via
+// cancel_flag.  out_hashes receives the number of digests computed.
+//
+// With n_threads > 1 the chunk range is split contiguously; the winner is
+// the minimum flat index among per-thread first finds (exact reference
+// order within each thread's range; across threads, first-in-order among
+// the finds that happened before shutdown — any valid secret is
+// acceptable per the puzzle contract, coordinator.go:202).
+int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
+                         uint32_t difficulty, const uint8_t* thread_bytes,
+                         size_t n_tb, uint32_t width, uint64_t chunk_start,
+                         uint64_t chunk_count, int32_t n_threads,
+                         const volatile int32_t* cancel_flag,
+                         uint64_t* out_hashes, uint8_t* out_secret) {
+  if (n_tb == 0 || width > 8) return -2;
+  SearchTask task{nonce,        nonce_len,  difficulty,
+                  thread_bytes, n_tb,       width,
+                  chunk_start,  chunk_start + chunk_count, cancel_flag};
+  Found found;
+  uint64_t hashes = 0;
+
+  if (n_threads <= 1 || chunk_count < 2) {
+    ScanRange(task, task.chunk_start, task.chunk_end, &found, &hashes);
+  } else {
+    const uint64_t nt = static_cast<uint64_t>(n_threads);
+    const uint64_t per = (chunk_count + nt - 1) / nt;
+    std::vector<std::thread> threads;
+    std::vector<uint64_t> thread_hashes(nt, 0);
+    for (uint64_t i = 0; i < nt; ++i) {
+      const uint64_t lo = task.chunk_start + i * per;
+      const uint64_t hi =
+          lo + per < task.chunk_end ? lo + per : task.chunk_end;
+      if (lo >= hi) break;
+      threads.emplace_back([&, lo, hi, i] {
+        ScanRange(task, lo, hi, &found, &thread_hashes[i]);
+      });
+    }
+    for (auto& th : threads) th.join();
+    for (uint64_t h : thread_hashes) hashes += h;
+  }
+
+  if (out_hashes) *out_hashes = hashes;
+  const uint64_t flat = found.flat_index.load();
+  if (flat != UINT64_MAX) {
+    const uint64_t chunk = chunk_start + flat / n_tb;
+    out_secret[0] = thread_bytes[flat % n_tb];
+    for (uint32_t j = 0; j < width; ++j) {
+      out_secret[1 + j] = static_cast<uint8_t>(chunk >> (8 * j));
+    }
+    return 1;
+  }
+  if (cancel_flag && *cancel_flag) return -1;
+  return 0;
+}
+
+// Self-test hook: MD5 of an arbitrary buffer (for binding-level checks).
+void distpow_md5(const uint8_t* data, size_t len, uint8_t out[16]) {
+  uint32_t state[4];
+  std::memcpy(state, kInitState, sizeof(state));
+  size_t full = (len / 64) * 64;
+  for (size_t off = 0; off < full; off += 64) Compress(state, data + off);
+  uint8_t tail[128];
+  std::memset(tail, 0, sizeof(tail));
+  size_t rem = len - full;
+  std::memcpy(tail, data + full, rem);
+  tail[rem] = 0x80;
+  size_t tail_len = rem + 9 <= 64 ? 64 : 128;
+  uint64_t bits = static_cast<uint64_t>(len) * 8;
+  for (int i = 0; i < 8; ++i)
+    tail[tail_len - 8 + i] = static_cast<uint8_t>(bits >> (8 * i));
+  for (size_t b = 0; b < tail_len; b += 64) Compress(state, tail + b);
+  std::memcpy(out, state, 16);
+}
+
+}  // extern "C"

@@ -1,0 +1,164 @@
+"""ctypes binding for the native C++ CPU miner (backends/native/).
+
+The native library is built on demand with the bundled Makefile (g++; no
+external dependencies).  This is the CPU-performance counterpart of the
+reference's Go worker loop for BASELINE.md configs 1-2 — same enumeration
+contract as every other backend, verified against the hashlib oracle in
+tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Callable, Optional, Sequence
+
+from ..models import puzzle
+from ..parallel.search import contiguous_bounds
+
+log = logging.getLogger("distpow.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdistpow_native.so")
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def load_library(build: bool = True) -> ctypes.CDLL:
+    """Load (building if needed) the native miner library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and build:
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True, capture_output=True, text=True,
+                )
+            except (OSError, subprocess.CalledProcessError) as exc:
+                detail = getattr(exc, "stderr", "") or str(exc)
+                raise NativeUnavailable(
+                    f"failed to build native miner: {detail}"
+                ) from exc
+        if not os.path.exists(_LIB_PATH):
+            raise NativeUnavailable(f"native miner library missing: {_LIB_PATH}")
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.distpow_search_range.restype = ctypes.c_int
+        lib.distpow_search_range.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,          # nonce
+            ctypes.c_uint32,                            # difficulty
+            ctypes.c_char_p, ctypes.c_size_t,          # thread bytes
+            ctypes.c_uint32,                            # width
+            ctypes.c_uint64, ctypes.c_uint64,          # chunk start/count
+            ctypes.c_int32,                             # n_threads
+            ctypes.POINTER(ctypes.c_int32),            # cancel flag
+            ctypes.POINTER(ctypes.c_uint64),           # out hashes
+            ctypes.c_char_p,                            # out secret
+        ]
+        lib.distpow_md5.restype = None
+        lib.distpow_md5.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        _lib = lib
+        return lib
+
+
+def native_md5(data: bytes) -> bytes:
+    lib = load_library()
+    out = ctypes.create_string_buffer(16)
+    lib.distpow_md5(data, len(data), out)
+    return out.raw
+
+
+class NativeBackend:
+    """C++ brute-force miner behind the standard backend interface."""
+
+    name = "native"
+
+    def __init__(
+        self,
+        hash_model: str = "md5",
+        n_threads: int = 0,
+        range_size: int = 1 << 22,
+        **_,
+    ):
+        if hash_model != "md5":
+            raise ValueError("native backend implements the md5 model")
+        self.n_threads = n_threads or (os.cpu_count() or 1)
+        self.range_size = range_size
+        self.lib = load_library()
+
+    def search(
+        self,
+        nonce: bytes,
+        difficulty: int,
+        thread_bytes: Sequence[int],
+        cancel_check: Optional[Callable[[], bool]] = None,
+    ) -> Optional[bytes]:
+        nonce = bytes(nonce)
+        contiguous_bounds(thread_bytes)  # validates the run
+        tb_buf = bytes(thread_bytes)
+        cancel = ctypes.c_int32(0)
+        hashes = ctypes.c_uint64(0)
+        secret_buf = ctypes.create_string_buffer(16)
+
+        stop_poll = threading.Event()
+        if cancel_check is not None:
+            # mirror the driver's between-batches poll as a tiny side thread
+            # flipping the native cancel flag
+            def poll():
+                while not stop_poll.is_set():
+                    if cancel_check():
+                        cancel.value = 1
+                        return
+                    stop_poll.wait(0.01)
+
+            threading.Thread(target=poll, daemon=True).start()
+
+        try:
+            # the native path enumerates full-width chunk integers in
+            # uint64 directly, so each width is one dense range (no
+            # high-byte segmenting like the uint32-lane device kernels)
+            for width in range(0, 8):
+                full_lo, full_hi = (
+                    (0, 1) if width == 0
+                    else (256 ** (width - 1), 256 ** width)
+                )
+                start = full_lo
+                while start < full_hi:
+                    count = min(self.range_size, full_hi - start)
+                    rc = self.lib.distpow_search_range(
+                        nonce, len(nonce),
+                        difficulty,
+                        tb_buf, len(tb_buf),
+                        width,
+                        start, count,
+                        self.n_threads,
+                        ctypes.byref(cancel),
+                        ctypes.byref(hashes),
+                        secret_buf,
+                    )
+                    if rc == 1:
+                        secret = secret_buf.raw[: 1 + width]
+                        if not puzzle.check_secret(nonce, secret, difficulty):
+                            raise RuntimeError(
+                                "native miner returned non-solving secret "
+                                f"{secret.hex()}"
+                            )
+                        return secret
+                    if rc == -1:
+                        return None
+                    if rc < 0:
+                        raise RuntimeError(f"native miner error rc={rc}")
+                    start += count
+            return None
+        finally:
+            stop_poll.set()

@@ -1,0 +1,82 @@
+"""Worker backend driving the Pallas MD5 kernel through the search loop.
+
+Plugs ``ops.md5_pallas`` into ``parallel.search`` via the step-factory
+protocol.  Launch geometry: the batch is rounded to a whole number of
+(sublanes, 128) tiles; configurations the kernel cannot express
+(non-power-of-two thread-byte runs, multi-block tails, non-MD5 models)
+fall back to the fused XLA step transparently.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..models.registry import get_hash_model
+from ..ops.md5_pallas import LANES, cached_pallas_search_step
+from ..ops.search_step import cached_search_step
+from ..parallel.search import contiguous_bounds, search
+
+
+class PallasBackend:
+    name = "pallas"
+
+    def __init__(
+        self,
+        hash_model: str = "md5",
+        batch_size: int = 1 << 20,
+        sublanes: int = 256,
+        interpret: bool = False,
+        **_,
+    ):
+        self.model = get_hash_model(hash_model)
+        self.batch_size = batch_size
+        self.sublanes = sublanes
+        self.interpret = interpret
+
+    def _factory(self, nonce: bytes, difficulty: int, tb_lo: int, tbc: int):
+        tile = self.sublanes * LANES
+
+        def factory(vw: int, extra: bytes, target_chunks: int):
+            if vw == 0:
+                # tiny width-0 probe: XLA step is fine
+                return (
+                    cached_search_step(
+                        nonce, vw, difficulty, tb_lo, tbc, 1,
+                        self.model.name, extra,
+                    ),
+                    1,
+                )
+            chunks = max(1, target_chunks)
+            batch = chunks * tbc
+            # round the batch up to a whole tile grid
+            if batch % tile:
+                batch = ((batch // tile) + 1) * tile
+                chunks = max(1, batch // tbc)
+            try:
+                step = cached_pallas_search_step(
+                    nonce, vw, difficulty, tb_lo, tbc, chunks,
+                    self.model.name, extra,
+                    self.sublanes, self.interpret,
+                )
+            except ValueError:
+                step = cached_search_step(
+                    nonce, vw, difficulty, tb_lo, tbc, chunks,
+                    self.model.name, extra,
+                )
+            return step, chunks
+
+        return factory
+
+    def search(self, nonce, difficulty, thread_bytes, cancel_check=None) -> Optional[bytes]:
+        nonce = bytes(nonce)
+        tb_lo, tbc = contiguous_bounds(thread_bytes)
+        res = search(
+            nonce,
+            difficulty,
+            thread_bytes,
+            model=self.model,
+            batch_size=self.batch_size,
+            cancel_check=cancel_check,
+            step_factory=self._factory(nonce, difficulty, tb_lo, tbc),
+        )
+        return None if res is None else res.secret

@@ -1,0 +1,70 @@
+"""Demo client entry point (cmd/client/main.go equivalent).
+
+Runs the reference's built-in smoke scenario (cmd/client/main.go:40-60):
+two clients, four mining requests — two concurrent distinct nonces plus a
+repeated nonce at increasing difficulty to exercise the dominance cache's
+miss-then-supersede path — then drains both notify queues.
+
+    python -m distpow_tpu.cli.client [--config PATH] [--config2 PATH]
+        [--id ID] [--id2 ID] [--difficulty N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import queue
+
+from ..nodes.client import Client
+from ..runtime.config import ClientConfig, read_json_config
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description="distpow demo client")
+    ap.add_argument("--config", default="config/client_config.json")
+    ap.add_argument("--config2", default="config/client2_config.json")
+    ap.add_argument("--id", help="Client ID override")
+    ap.add_argument("--id2", help="Second client ID override")
+    ap.add_argument(
+        "--difficulty", type=int, default=5,
+        help="base difficulty in nibbles (the repeat-nonce request adds 2)",
+    )
+    args = ap.parse_args(argv)
+
+    cfg1 = read_json_config(args.config, ClientConfig)
+    cfg2 = read_json_config(args.config2, ClientConfig)
+    if args.id:
+        cfg1.ClientID = args.id
+    if args.id2:
+        cfg2.ClientID = args.id2
+
+    client1, client2 = Client(cfg1), Client(cfg2)
+    client1.initialize()
+    client2.initialize()
+    try:
+        d = args.difficulty
+        client1.mine(bytes([1, 2, 3, 4]), d + 2)
+        client1.mine(bytes([5, 6, 7, 8]), d)
+        client2.mine(bytes([2, 2, 2, 2]), d)
+        client2.mine(bytes([2, 2, 2, 2]), d + 2)
+
+        remaining = 4
+        while remaining:
+            for c in (client1, client2):
+                try:
+                    r = c.notify_queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                logging.info(
+                    "MineResult nonce=%s difficulty=%d secret=%s",
+                    r.nonce.hex(), r.num_trailing_zeros, r.secret.hex(),
+                )
+                remaining -= 1
+    finally:
+        client1.close()
+        client2.close()
+
+
+if __name__ == "__main__":
+    main()

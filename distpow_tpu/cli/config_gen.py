@@ -1,0 +1,91 @@
+"""Config-gen utility (cmd/config-gen/main.go equivalent).
+
+Rewrites the ``config/*.json`` set with pseudo-random local ports
+(1024-35534) while keeping cross-references consistent: the tracing server
+address lands in every node config, the coordinator's client/worker listen
+addresses land in the client/worker configs, and each coordinator worker
+slot gets a fresh port.  Keeps the worker list length from the existing
+coordinator config (cmd/config-gen/main.go:51-88).
+
+    python -m distpow_tpu.cli.config_gen [--config-dir DIR] [--host HOST]
+        [--workers N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+
+from ..runtime.config import (
+    ClientConfig,
+    CoordinatorConfig,
+    TracingServerConfig,
+    WorkerConfig,
+    read_json_config,
+    write_json_config,
+)
+
+
+def gen_port(rng: random.Random) -> int:
+    return rng.randrange(1024, 35535)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="randomize distpow config ports")
+    ap.add_argument("--config-dir", default="config")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="host part written into addresses ('' for bare :port)")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="override worker count (default: keep existing)")
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args(argv)
+    rng = random.Random(args.seed)
+
+    def addr() -> str:
+        return f"{args.host}:{gen_port(rng)}"
+
+    d = args.config_dir
+    os.makedirs(d, exist_ok=True)
+
+    def load(name, cls):
+        path = os.path.join(d, name)
+        return read_json_config(path, cls) if os.path.exists(path) else cls()
+
+    tracer_addr = addr()
+    coord_client_addr = addr()
+    coord_worker_addr = addr()
+
+    ts = load("tracing_server_config.json", TracingServerConfig)
+    ts.ServerBind = tracer_addr
+    write_json_config(os.path.join(d, "tracing_server_config.json"), ts)
+
+    coord = load("coordinator_config.json", CoordinatorConfig)
+    n = args.workers or len(coord.Workers) or 4
+    coord.Workers = [addr() for _ in range(n)]
+    coord.TracerServerAddr = tracer_addr
+    coord.ClientAPIListenAddr = coord_client_addr
+    coord.WorkerAPIListenAddr = coord_worker_addr
+    write_json_config(os.path.join(d, "coordinator_config.json"), coord)
+
+    for name in ("client_config.json", "client2_config.json"):
+        c = load(name, ClientConfig)
+        if name == "client2_config.json" and c.ClientID == "client1":
+            c.ClientID = "client2"
+        c.TracerServerAddr = tracer_addr
+        c.CoordAddr = coord_client_addr
+        write_json_config(os.path.join(d, name), c)
+
+    w = load("worker_config.json", WorkerConfig)
+    w.TracerServerAddr = tracer_addr
+    w.CoordAddr = coord_worker_addr
+    w.ListenAddr = "PASS VIA COMMAND-LINE"
+    write_json_config(os.path.join(d, "worker_config.json"), w)
+
+    print(f"wrote configs to {d}: tracer={tracer_addr} "
+          f"coordinator client={coord_client_addr} worker={coord_worker_addr} "
+          f"workers={coord.Workers}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Coordinator entry point (cmd/coordinator/main.go equivalent).
+
+    python -m distpow_tpu.cli.coordinator [--config PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from ..nodes.coordinator import Coordinator
+from ..runtime.config import CoordinatorConfig, read_json_config
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description="distpow coordinator")
+    ap.add_argument("--config", default="config/coordinator_config.json")
+    args = ap.parse_args(argv)
+
+    config = read_json_config(args.config, CoordinatorConfig)
+    logging.info("coordinator config: %s", config)
+    Coordinator(config).run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Worker entry point (cmd/worker/main.go equivalent).
+
+    python -m distpow_tpu.cli.worker [--config PATH] [--id ID]
+        [--listen ADDR] [--backend {python,jax,jax-mesh,pallas,native}]
+
+``--id`` and ``--listen`` override the config file the same way the
+reference's flags do (cmd/worker/main.go:15-16); ``--backend`` selects the
+compute path (TPU-native extension).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from ..nodes.worker import Worker
+from ..runtime.config import WorkerConfig, read_json_config
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description="distpow worker")
+    ap.add_argument("--config", default="config/worker_config.json")
+    ap.add_argument("--id", help="Worker ID, e.g. worker1")
+    ap.add_argument("--listen", help="Listen address, e.g. 127.0.0.1:5000")
+    ap.add_argument("--backend", help="Compute backend override")
+    args = ap.parse_args(argv)
+
+    config = read_json_config(args.config, WorkerConfig)
+    if args.id:
+        config.WorkerID = args.id
+    if args.listen:
+        config.ListenAddr = args.listen
+    if args.backend:
+        config.Backend = args.backend
+    logging.info("worker config: %s", config)
+    Worker(config).run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -61,18 +61,29 @@ def _rotr(x, s):
     return (x >> s) | (x << (32 - s))
 
 
-_K_ARRAY = None
-
-
 def _k_array():
-    global _K_ARRAY
-    if _K_ARRAY is None:
-        _K_ARRAY = jnp.asarray(np.array(SHA256_K, np.uint32))
-    return _K_ARRAY
+    # built fresh per trace: caching the array in a module global would
+    # leak a tracer when first created inside a jit trace
+    return jnp.asarray(np.array(SHA256_K, np.uint32))
 
 
 def sha256_compress(state, words: Sequence):
-    """One SHA-256 block compression, vectorized over broadcastable words."""
+    """One SHA-256 block compression, vectorized over broadcastable words.
+
+    Eager calls route through a module-level jit so the two fori_loops
+    compile once per shape signature instead of re-tracing per call (the
+    loop bodies are closures, which defeat eager fori_loop caching).
+    Under an outer jit the nested jit is inlined.
+    """
+    # pre-convert: python ints above 2^31 would overflow the default int32
+    # when parsed as jit arguments
+    return _sha256_compress_jit(
+        tuple(_u32(s) for s in state), tuple(_u32(w) for w in words)
+    )
+
+
+@jax.jit
+def _sha256_compress_jit(state, words):
     ws = [_u32(m) for m in words]
     shape = jnp.broadcast_shapes(*(jnp.shape(w) for w in ws))
     w16 = jnp.stack([jnp.broadcast_to(w, shape) for w in ws])

@@ -1,0 +1,9 @@
+from .client import Client
+from .coordinator import CoordRPCHandler, Coordinator
+from .powlib import POW, MineResult
+from .worker import Worker, WorkerRPCHandler
+
+__all__ = [
+    "Client", "CoordRPCHandler", "Coordinator",
+    "POW", "MineResult", "Worker", "WorkerRPCHandler",
+]

@@ -1,0 +1,55 @@
+"""Client wrapper binding config + tracer + powlib into a node object
+(SURVEY.md section 2 component 2; reference: client.go).
+
+``ChCapacity`` defaults to 10 (client.go:9).  ``initialize`` may only run
+once per instance (client.go:44-46); ``mine`` delegates to powlib with
+this client's tracer; ``close`` tears down tracer then powlib
+(client.go:61-68).
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Optional
+
+from ..runtime.config import ClientConfig
+from ..runtime.tracing import make_tracer
+from .powlib import POW, MineResult
+
+
+class Client:
+    def __init__(self, config: ClientConfig, pow_: Optional[POW] = None, sink=None):
+        self.config = config
+        self.pow = pow_ or POW()
+        self.tracer = None
+        self._sink = sink
+        self.notify_queue: Optional["queue.Queue[MineResult]"] = None
+        self._initialized = False
+
+    def initialize(self) -> "queue.Queue[MineResult]":
+        if self._initialized:
+            raise RuntimeError("client has been initialized before")
+        self.notify_queue = self.pow.initialize(
+            self.config.CoordAddr, self.config.ChCapacity
+        )
+        self.tracer = make_tracer(
+            self.config.ClientID,
+            self.config.TracerServerAddr,
+            self.config.TracerSecret,
+            sink=self._sink,
+        )
+        self._initialized = True
+        return self.notify_queue
+
+    def mine(self, nonce: bytes, num_trailing_zeros: int) -> None:
+        if not self._initialized:
+            raise RuntimeError("client not initialized")
+        self.pow.mine(self.tracer, nonce, num_trailing_zeros)
+
+    def close(self) -> None:
+        # powlib first: it joins in-flight mine threads, which may still
+        # record actions — the tracer's sink must outlive them
+        self.pow.close()
+        if self.tracer is not None:
+            self.tracer.close()
+        self._initialized = False

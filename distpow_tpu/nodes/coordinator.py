@@ -1,0 +1,302 @@
+"""Coordinator node — the control plane (SURVEY.md section 2 component 3).
+
+Implements the reference's orchestration spine (coordinator.go:139-298):
+
+blocking ``Mine`` RPC:
+  1. receive token, record ``CoordinatorMine``;
+  2. dominance-cache lookup — on hit record ``CoordinatorSuccess`` and
+     reply immediately (coordinator.go:150-166);
+  3. on miss, ensure worker connections (dial-retry,
+     coordinator.go:169-172,356-368), register a per-task result queue
+     (capacity semantics of the 2N-buffered channel,
+     coordinator.go:176-177);
+  4. fan out ``WorkerRPCHandler.Mine`` to every worker with its partition
+     byte (``CoordinatorWorkerMine`` per worker);
+  5. block for the first result — first-result-wins;
+  6. broadcast ``WorkerRPCHandler.Found`` with the winning secret to every
+     worker (``CoordinatorWorkerCancel`` per worker) — cancellation and
+     cache-install in one message;
+  7. drain the 2N-ack ledger: every worker owes exactly two messages per
+     round (finder: result + ACK; cancelled: ACK + ACK); late non-nil
+     results are collected (coordinator.go:237-248);
+  8. for each late result, re-broadcast ``Found`` (cache convergence) and
+     drain N more ACKs (coordinator.go:250-280);
+  9. delete the task, record ``CoordinatorSuccess``, reply with a fresh
+     token.
+
+``Result`` RPC (coordinator.go:302-320): non-nil secrets are recorded
+(``CoordinatorWorkerResult``) and installed into the coordinator cache,
+then the payload is routed to the owning task queue.
+
+Documented fixes over the reference (SURVEY.md section 7 "hard parts"):
+
+* late ``Result`` after task deletion: the reference sends on a nil
+  channel and leaks the RPC goroutine forever (coordinator.go:318,
+  370-374); here the message is logged and dropped.
+* duplicate concurrent ``Mine`` for the same (nonce, zeros): the
+  reference overwrites the task queue and strands the first request
+  (coordinator.go:376-381); here a per-key mutex serializes the miss
+  path — the duplicate blocks, then (re-)checks the cache and typically
+  returns the first request's result as a hit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..parallel.partition import worker_bits as partition_worker_bits
+from ..runtime import actions as act
+from ..runtime.cache import ResultCache
+from ..runtime.config import CoordinatorConfig
+from ..runtime.rpc import RPCClient, RPCServer
+from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
+
+log = logging.getLogger("distpow.coordinator")
+
+TaskKey = Tuple[bytes, int]
+
+
+class WorkerRef:
+    def __init__(self, addr: str, worker_byte: int):
+        self.addr = addr
+        self.worker_byte = worker_byte
+        self.client: Optional[RPCClient] = None
+
+
+class CoordRPCHandler:
+    """RPC service ``CoordRPCHandler`` (Mine / Result)."""
+
+    def __init__(self, tracer: Tracer, worker_addrs: List[str],
+                 dial_retry_interval: float = 0.2):
+        self.tracer = tracer
+        self.workers = [WorkerRef(a, i) for i, a in enumerate(worker_addrs)]
+        # floor(log2(N)) with the reference's uint truncation
+        # (coordinator.go:326); see parallel/partition.py for the
+        # non-power-of-two coverage discussion.
+        self.worker_bits = partition_worker_bits(len(worker_addrs))
+        self.result_cache = ResultCache()
+        self._tasks: Dict[TaskKey, "queue.Queue"] = {}
+        self._tasks_lock = threading.Lock()
+        self._key_locks: Dict[TaskKey, list] = {}
+        self._dial_retry_interval = dial_retry_interval
+
+    # -- task table (coordinator.go:370-388) -------------------------------
+    def _task_set(self, key: TaskKey, q: "queue.Queue") -> None:
+        with self._tasks_lock:
+            self._tasks[key] = q
+
+    def _task_get(self, key: TaskKey) -> Optional["queue.Queue"]:
+        with self._tasks_lock:
+            return self._tasks.get(key)
+
+    def _task_delete(self, key: TaskKey) -> None:
+        with self._tasks_lock:
+            self._tasks.pop(key, None)
+
+    @contextlib.contextmanager
+    def _key_lock(self, key: TaskKey):
+        """Hold the per-(nonce, zeros) mutex; entries are refcounted and
+        pruned when the last waiter releases, so arbitrary client nonces
+        can't grow the map without bound."""
+        with self._tasks_lock:
+            entry = self._key_locks.get(key)
+            if entry is None:
+                entry = self._key_locks[key] = [threading.Lock(), 0]
+            entry[1] += 1
+        try:
+            with entry[0]:
+                yield
+        finally:
+            with self._tasks_lock:
+                entry[1] -= 1
+                if entry[1] == 0 and self._key_locks.get(key) is entry:
+                    del self._key_locks[key]
+
+    # -- worker connections (coordinator.go:356-368) ------------------------
+    def _initialize_workers(self) -> None:
+        while True:
+            pending = [w for w in self.workers if w.client is None]
+            if not pending:
+                return
+            for w in pending:
+                try:
+                    w.client = RPCClient(w.addr)
+                except OSError as exc:
+                    log.info("waiting for worker %d: %s", w.worker_byte, exc)
+                    time.sleep(self._dial_retry_interval)
+                    break
+
+    # -- RPCs ---------------------------------------------------------------
+    def Mine(self, params) -> dict:
+        nonce = bytes(params["nonce"])
+        ntz = int(params["num_trailing_zeros"])
+        trace = self.tracer.receive_token(decode_token(params["token"]))
+        trace.record_action(
+            act.CoordinatorMine(nonce=nonce, num_trailing_zeros=ntz)
+        )
+
+        cached = self.result_cache.get(nonce, ntz, trace)
+        if cached is not None:
+            return self._success_reply(trace, nonce, ntz, cached)
+
+        # serialize concurrent identical requests (documented fix; the
+        # second request re-checks the cache once the first completes)
+        with self._key_lock((nonce, ntz)):
+            cached = self.result_cache.get(nonce, ntz, trace)
+            if cached is not None:
+                return self._success_reply(trace, nonce, ntz, cached)
+            return self._mine_miss(trace, nonce, ntz)
+
+    def _mine_miss(self, trace, nonce: bytes, ntz: int) -> dict:
+        self._initialize_workers()
+        n = len(self.workers)
+        key = (nonce, ntz)
+        results: "queue.Queue" = queue.Queue(maxsize=2 * n)
+        self._task_set(key, results)
+
+        for w in self.workers:
+            trace.record_action(
+                act.CoordinatorWorkerMine(
+                    nonce=nonce, num_trailing_zeros=ntz,
+                    worker_byte=w.worker_byte,
+                )
+            )
+            w.client.call(
+                "WorkerRPCHandler.Mine",
+                {
+                    "nonce": list(nonce),
+                    "num_trailing_zeros": ntz,
+                    "worker_byte": w.worker_byte,
+                    "worker_bits": self.worker_bits,
+                    "token": encode_token(trace.generate_token()),
+                },
+            )
+
+        # first-result-wins (coordinator.go:202-206)
+        first = results.get()
+        if first["secret"] is None:
+            raise RuntimeError(
+                "protocol violation: first worker message was a cancellation "
+                f"ACK from worker_byte={first['worker_byte']}"
+            )
+        winner = bytes(first["secret"])
+
+        self._broadcast_found(trace, nonce, ntz, winner)
+
+        # 2N-ack ledger (coordinator.go:237-248)
+        seen = 1
+        late: List[dict] = []
+        while seen < 2 * n:
+            msg = results.get()
+            if msg["secret"] is not None:
+                late.append(msg)
+                log.info("late worker result: %s", msg["worker_byte"])
+            seen += 1
+
+        # late-result cache propagation (coordinator.go:250-280)
+        for msg in late:
+            self._broadcast_found(trace, nonce, ntz, bytes(msg["secret"]))
+            for _ in range(n):
+                results.get()
+
+        self._task_delete(key)
+        return self._success_reply(trace, nonce, ntz, winner)
+
+    def _broadcast_found(self, trace, nonce: bytes, ntz: int, secret: bytes) -> None:
+        for w in self.workers:
+            trace.record_action(
+                act.CoordinatorWorkerCancel(
+                    nonce=nonce, num_trailing_zeros=ntz,
+                    worker_byte=w.worker_byte,
+                )
+            )
+            w.client.call(
+                "WorkerRPCHandler.Found",
+                {
+                    "nonce": list(nonce),
+                    "num_trailing_zeros": ntz,
+                    "worker_byte": w.worker_byte,
+                    "secret": list(secret),
+                    "token": encode_token(trace.generate_token()),
+                },
+            )
+
+    def _success_reply(self, trace, nonce: bytes, ntz: int, secret: bytes) -> dict:
+        trace.record_action(
+            act.CoordinatorSuccess(
+                nonce=nonce, num_trailing_zeros=ntz, secret=secret
+            )
+        )
+        return {
+            "nonce": list(nonce),
+            "num_trailing_zeros": ntz,
+            "secret": list(secret),
+            "token": encode_token(trace.generate_token()),
+        }
+
+    def Result(self, params) -> dict:
+        nonce = bytes(params["nonce"])
+        ntz = int(params["num_trailing_zeros"])
+        trace = self.tracer.receive_token(decode_token(params["token"]))
+        if params.get("secret") is not None:
+            trace.record_action(
+                act.CoordinatorWorkerResult(
+                    nonce=nonce,
+                    num_trailing_zeros=ntz,
+                    worker_byte=int(params["worker_byte"]),
+                    secret=bytes(params["secret"]),
+                )
+            )
+            self.result_cache.add(nonce, ntz, bytes(params["secret"]), trace)
+        q = self._task_get((nonce, ntz))
+        if q is None:
+            # documented fix: the reference blocks forever on a nil channel
+            # here (coordinator.go:318); we log and drop instead.
+            log.warning("result for unknown task %s/%d dropped", nonce.hex(), ntz)
+            return {}
+        q.put(params)
+        return {}
+
+
+class Coordinator:
+    """Coordinator process object (NewCoordinator/InitializeRPCs,
+    coordinator.go:115-136, 322-354)."""
+
+    def __init__(self, config: CoordinatorConfig, sink=None):
+        self.config = config
+        self.tracer = make_tracer(
+            "coordinator", config.TracerServerAddr, config.TracerSecret,
+            sink=sink,
+        )
+        self.handler = CoordRPCHandler(self.tracer, list(config.Workers))
+        self.server = RPCServer()
+        self.server.register("CoordRPCHandler", self.handler)
+        self.client_addr: Optional[str] = None
+        self.worker_addr: Optional[str] = None
+
+    def initialize_rpcs(self) -> Tuple[str, str]:
+        """Bind the segregated worker-facing and client-facing listeners."""
+        self.worker_addr = self.server.listen(self.config.WorkerAPIListenAddr)
+        self.client_addr = self.server.listen(self.config.ClientAPIListenAddr)
+        self.server.serve_in_background()
+        log.info(
+            "coordinator serving clients on %s, workers on %s",
+            self.client_addr, self.worker_addr,
+        )
+        return self.client_addr, self.worker_addr
+
+    def run_forever(self) -> None:
+        self.initialize_rpcs()
+        threading.Event().wait()
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+        for w in self.handler.workers:
+            if w.client is not None:
+                w.client.close()
+        self.tracer.close()

@@ -1,0 +1,144 @@
+"""powlib — the asynchronous client mining library
+(SURVEY.md section 2 component 1; reference: powlib/powlib.go).
+
+API parity:
+
+* ``initialize(coord_addr, ch_capacity)`` connects to the coordinator and
+  returns the bounded notify queue solutions are delivered on
+  (powlib.go:76-93).
+* ``mine(tracer, nonce, num_trailing_zeros)`` is non-blocking
+  (powlib.go:102-113): it creates a fresh trace, records
+  ``PowlibMiningBegin``, and hands off to a request thread which records
+  ``PowlibMine``, embeds a token in the RPC args, and issues the async
+  ``CoordRPCHandler.Mine`` call (powlib.go:137-156).
+* On completion the response token is received back into the tracer and
+  ``PowlibSuccess`` + ``PowlibMiningComplete`` are recorded before the
+  result lands on the notify queue (powlib.go:164-176).
+* ``close()`` stops delivery: in-flight request threads abandon their
+  calls (powlib.go:119-135, 179-182) and the connection closes.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent.futures import CancelledError
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from dataclasses import dataclass
+from typing import Optional
+
+from ..runtime import actions as act
+from ..runtime.rpc import RPCClient, RPCError
+from ..runtime.tracing import Tracer, decode_token, encode_token
+
+log = logging.getLogger("distpow.powlib")
+
+
+@dataclass
+class MineResult:
+    nonce: bytes
+    num_trailing_zeros: int
+    secret: bytes
+    token: Optional[bytes] = None
+
+
+class POW:
+    def __init__(self):
+        self.coordinator: Optional[RPCClient] = None
+        self.notify_queue: Optional["queue.Queue[MineResult]"] = None
+        self._close_ev = threading.Event()
+        self._inflight: set = set()
+        self._inflight_lock = threading.Lock()
+
+    def initialize(self, coord_addr: str, ch_capacity: int) -> "queue.Queue[MineResult]":
+        log.info("dialing coordinator at %s", coord_addr)
+        self.coordinator = RPCClient(coord_addr)
+        self.notify_queue = queue.Queue(maxsize=ch_capacity)
+        self._close_ev.clear()
+        return self.notify_queue
+
+    def mine(self, tracer: Tracer, nonce: bytes, num_trailing_zeros: int) -> None:
+        if self.coordinator is None:
+            raise RuntimeError("powlib not initialized")
+        nonce = bytes(nonce)
+        trace = tracer.create_trace()
+        trace.record_action(
+            act.PowlibMiningBegin(nonce=nonce, num_trailing_zeros=num_trailing_zeros)
+        )
+        t = threading.Thread(
+            target=self._call_mine,
+            args=(tracer, nonce, num_trailing_zeros, trace),
+            daemon=True,
+        )
+        with self._inflight_lock:
+            self._inflight.add(t)
+        t.start()
+
+    def _call_mine(self, tracer, nonce, num_trailing_zeros, trace) -> None:
+        try:
+            trace.record_action(
+                act.PowlibMine(nonce=nonce, num_trailing_zeros=num_trailing_zeros)
+            )
+            fut = self.coordinator.go(
+                "CoordRPCHandler.Mine",
+                {
+                    "nonce": list(nonce),
+                    "num_trailing_zeros": num_trailing_zeros,
+                    "token": encode_token(trace.generate_token()),
+                },
+            )
+            while True:
+                if self._close_ev.is_set():
+                    log.info("mine call abandoned on close")
+                    return
+                try:
+                    result = fut.result(timeout=0.05)
+                    break
+                except (TimeoutError, FutureTimeoutError):
+                    # both spellings: concurrent.futures.TimeoutError is
+                    # only an alias of the builtin since Python 3.11
+                    continue
+                except CancelledError:
+                    return
+                except RPCError as exc:
+                    log.error("mine RPC failed: %s", exc)
+                    return
+            token = decode_token(result["token"])
+            result_trace = tracer.receive_token(token)
+            mr = MineResult(
+                nonce=bytes(result["nonce"]),
+                num_trailing_zeros=int(result["num_trailing_zeros"]),
+                secret=bytes(result["secret"]),
+                token=token,
+            )
+            result_trace.record_action(
+                act.PowlibSuccess(
+                    nonce=mr.nonce,
+                    num_trailing_zeros=mr.num_trailing_zeros,
+                    secret=mr.secret,
+                )
+            )
+            result_trace.record_action(
+                act.PowlibMiningComplete(
+                    nonce=mr.nonce,
+                    num_trailing_zeros=mr.num_trailing_zeros,
+                    secret=mr.secret,
+                )
+            )
+            if not self._close_ev.is_set():
+                self.notify_queue.put(mr)
+        finally:
+            with self._inflight_lock:
+                self._inflight.discard(threading.current_thread())
+
+    def close(self) -> None:
+        self._close_ev.set()
+        with self._inflight_lock:
+            threads = list(self._inflight)
+        for t in threads:
+            t.join(timeout=5)
+        if self.coordinator is not None:
+            self.coordinator.close()
+            self.coordinator = None
+        log.info("powlib closed")

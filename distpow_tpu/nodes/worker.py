@@ -1,0 +1,235 @@
+"""Worker node — the compute plane (SURVEY.md section 2 component 4).
+
+Protocol parity with the reference worker (worker.go):
+
+* ``Mine`` RPC (worker.go:169-185): registers a cancellable task *before*
+  receiving the token, records ``WorkerMine``, then kicks off the miner
+  asynchronously so the RPC returns immediately.
+* ``Found`` RPC (worker.go:202-232) doubles as cancellation and cache
+  install.  If the task is still active: install the winning secret into
+  the worker cache, fire the cancel event, delete the task — the miner
+  thread then emits its ``WorkerCancel`` + nil-secret ACK.  If no task is
+  active (late re-broadcast, or a repeat ``Found``): record
+  ``WorkerCancel`` here, install the cache entry, and ACK directly.
+* ``Cancel`` RPC (worker.go:189-198): legacy plain cancellation, kept for
+  API parity (the reference coordinator never calls it).
+* The miner (worker.go:258-401): consult the dominance cache first; on a
+  hit, replay the found-path (result -> wait for cancel -> ``WorkerCancel``
+  -> nil ACK).  Otherwise expand the worker's thread-byte partition and
+  run the configured compute backend.  The found-path *blocks on the
+  cancel event after sending the result* so ``WorkerCancel`` is always the
+  trace's final worker action — same ordering discipline the reference
+  enforces by blocking on killChan (worker.go:375-379).  A cancelled miner
+  sends TWO nil ACKs (worker.go:327-341): one for the in-flight round, one
+  consumed by the coordinator's 2N-ack ledger.
+
+Divergence from the reference (documented, SURVEY.md section 7): the
+reference polls its cancel channel once per candidate; accelerator
+backends poll between batches, so cancellation latency is one batch.
+
+Results leave through a queue drained by a forwarder thread issuing async
+``CoordRPCHandler.Result`` calls — the cmd/worker/main.go:27-36 loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..backends import get_backend
+from ..parallel import partition
+from ..runtime import actions as act
+from ..runtime.cache import ResultCache
+from ..runtime.config import WorkerConfig
+from ..runtime.rpc import RPCClient, RPCServer
+from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
+
+log = logging.getLogger("distpow.worker")
+
+TaskKey = Tuple[bytes, int, int]  # (nonce, num_trailing_zeros, worker_byte)
+
+
+def _key(params) -> TaskKey:
+    return (bytes(params["nonce"]), int(params["num_trailing_zeros"]),
+            int(params["worker_byte"]))
+
+
+class WorkerRPCHandler:
+    """RPC service ``WorkerRPCHandler`` (Mine / Found / Cancel)."""
+
+    def __init__(self, tracer: Tracer, result_queue: "queue.Queue", backend):
+        self.tracer = tracer
+        self.result_queue = result_queue
+        self.backend = backend
+        self.result_cache = ResultCache()
+        self._tasks: Dict[TaskKey, threading.Event] = {}
+        self._tasks_lock = threading.Lock()
+
+    # -- task table (worker.go:403-421) -----------------------------------
+    def _task_set(self, key: TaskKey, ev: threading.Event) -> None:
+        with self._tasks_lock:
+            self._tasks[key] = ev
+
+    def _task_pop(self, key: TaskKey) -> Optional[threading.Event]:
+        with self._tasks_lock:
+            return self._tasks.pop(key, None)
+
+    def _task_get(self, key: TaskKey) -> Optional[threading.Event]:
+        with self._tasks_lock:
+            return self._tasks.get(key)
+
+    # -- RPCs ---------------------------------------------------------------
+    def Mine(self, params) -> dict:
+        key = _key(params)
+        cancel_ev = threading.Event()
+        self._task_set(key, cancel_ev)
+
+        trace = self.tracer.receive_token(decode_token(params["token"]))
+        trace.record_action(
+            act.WorkerMine(
+                nonce=key[0], num_trailing_zeros=key[1], worker_byte=key[2]
+            )
+        )
+        threading.Thread(
+            target=self._mine,
+            args=(key, int(params["worker_bits"]), cancel_ev, trace),
+            daemon=True,
+        ).start()
+        return {}
+
+    def Found(self, params) -> dict:
+        key = _key(params)
+        secret = bytes(params["secret"])
+        trace = self.tracer.receive_token(decode_token(params["token"]))
+        ev = self._task_pop(key)
+        if ev is not None:
+            self.result_cache.add(key[0], key[1], secret, trace)
+            ev.set()
+        else:
+            # no active task: cache-update-only round (late-result
+            # re-broadcast or repeat Found), worker.go:212-230
+            trace.record_action(
+                act.WorkerCancel(
+                    nonce=key[0], num_trailing_zeros=key[1], worker_byte=key[2]
+                )
+            )
+            self.result_cache.add(key[0], key[1], secret, trace)
+            self._send_result(key, None, trace)
+        return {}
+
+    def Cancel(self, params) -> dict:
+        key = _key(params)
+        ev = self._task_pop(key)
+        if ev is None:
+            raise RuntimeError(f"no active task for cancel: {key}")
+        ev.set()
+        return {}
+
+    # -- miner (worker.go:258-401) -----------------------------------------
+    def _send_result(self, key: TaskKey, secret: Optional[bytes], trace) -> None:
+        self.result_queue.put(
+            {
+                "nonce": list(key[0]),
+                "num_trailing_zeros": key[1],
+                "worker_byte": key[2],
+                "secret": list(secret) if secret is not None else None,
+                "token": encode_token(trace.generate_token()),
+            }
+        )
+
+    def _finish_found(self, key: TaskKey, secret: bytes, cancel_ev, trace) -> None:
+        """Result -> block for Found -> WorkerCancel -> nil ACK ordering."""
+        trace.record_action(
+            act.WorkerResult(
+                nonce=key[0], num_trailing_zeros=key[1],
+                worker_byte=key[2], secret=secret,
+            )
+        )
+        self._send_result(key, secret, trace)
+        cancel_ev.wait()  # coordinator always sends Found (worker.go:375-379)
+        trace.record_action(
+            act.WorkerCancel(
+                nonce=key[0], num_trailing_zeros=key[1], worker_byte=key[2]
+            )
+        )
+        self._send_result(key, None, trace)
+
+    def _mine(self, key: TaskKey, worker_bits: int, cancel_ev, trace) -> None:
+        nonce, ntz, worker_byte = key
+        cached = self.result_cache.get(nonce, ntz, trace)
+        if cached is not None:
+            self._finish_found(key, cached, cancel_ev, trace)
+            return
+
+        tbs = partition.thread_bytes(worker_byte, worker_bits)
+        secret = self.backend.search(
+            nonce, ntz, tbs, cancel_check=cancel_ev.is_set
+        )
+        if secret is not None:
+            self._finish_found(key, secret, cancel_ev, trace)
+            return
+
+        # cancelled mid-search: two nil ACKs (worker.go:320-345)
+        trace.record_action(
+            act.WorkerCancel(
+                nonce=nonce, num_trailing_zeros=ntz, worker_byte=worker_byte
+            )
+        )
+        self._send_result(key, None, trace)
+        self._send_result(key, None, trace)
+
+
+class Worker:
+    """Worker process object: RPC server + result forwarder
+    (NewWorker/InitializeWorkerRPCs, worker.go:116-165 +
+    cmd/worker/main.go:27-36)."""
+
+    def __init__(self, config: WorkerConfig, sink=None):
+        self.config = config
+        self.tracer = make_tracer(
+            config.WorkerID, config.TracerServerAddr, config.TracerSecret,
+            sink=sink,
+        )
+        self.coordinator = RPCClient(config.CoordAddr)
+        self.result_queue: "queue.Queue" = queue.Queue()
+        backend = get_backend(
+            config.Backend,
+            hash_model=config.HashModel,
+            batch_size=config.BatchSize,
+            mesh_devices=config.MeshDevices,
+        )
+        self.handler = WorkerRPCHandler(self.tracer, self.result_queue, backend)
+        self.server = RPCServer()
+        self.server.register("WorkerRPCHandler", self.handler)
+        self.bound_addr: Optional[str] = None
+        self._forwarder: Optional[threading.Thread] = None
+
+    def initialize_rpcs(self) -> str:
+        self.bound_addr = self.server.listen(self.config.ListenAddr)
+        self.server.serve_in_background()
+        log.info("serving %s RPCs on %s", self.config.WorkerID, self.bound_addr)
+        return self.bound_addr
+
+    def start_forwarder(self) -> None:
+        def forward():
+            while True:
+                res = self.result_queue.get()
+                if res is None:
+                    return
+                self.coordinator.go("CoordRPCHandler.Result", res)
+
+        self._forwarder = threading.Thread(target=forward, daemon=True)
+        self._forwarder.start()
+
+    def run_forever(self) -> None:
+        self.initialize_rpcs()
+        self.start_forwarder()
+        threading.Event().wait()
+
+    def shutdown(self) -> None:
+        self.result_queue.put(None)
+        self.server.shutdown()
+        self.coordinator.close()
+        self.tracer.close()

@@ -1,0 +1,202 @@
+"""Pallas TPU kernel for the fused MD5 proof-of-work search step.
+
+The hot op of the framework (SURVEY.md section 7 layer 4, the "north
+star"): one kernel launch evaluates a dense tile grid of candidates —
+index -> message words -> 64 MD5 rounds -> trailing-nibble mask -> argmin
+— entirely in VMEM/registers.  Nothing but one uint32 scalar (the chunk
+base) enters the kernel and one uint32 per grid tile (the tile's first-hit
+flat index, or SENTINEL) leaves it; candidate messages are never
+materialized anywhere, not even in HBM.
+
+Layout: each grid step processes a (SUBLANES, 128) tile of flat candidate
+indices (uint32 native tile is (8, 128); SUBLANES is a multiple of 8).
+The flat index decomposes as ``f = chunk_offset * tb_count + tb_index``
+with ``tb_count`` a power of two (the partition algebra only produces
+power-of-two runs, worker.go:312-316), so the decomposition is a shift
+and a mask — no integer division in the kernel.
+
+The same computation expressed in plain jnp (ops/search_step.py) leaves
+fusion decisions to XLA; this kernel pins them.  Both paths share the
+packing template and difficulty masks, and tests/test_pallas.py checks
+them equal in interpret mode; bench.py compares them on hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.md5_jax import MD5_INIT, MD5_K, MD5_S
+from ..models.registry import get_hash_model
+from .difficulty import nibble_masks
+from .packing import build_tail_spec
+from .search_step import SENTINEL
+
+LANES = 128
+DEFAULT_SUBLANES = 256  # (256, 128) tile = 32768 candidates per grid step
+_I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
+
+
+def _rotl(x, s: int):
+    return (x << s) | (x >> (32 - s))
+
+
+def _md5_tile(words):
+    """Unrolled 64-round MD5 on a tile; ``words[g]`` is an array or int."""
+    a = jnp.uint32(MD5_INIT[0])
+    b = jnp.uint32(MD5_INIT[1])
+    c = jnp.uint32(MD5_INIT[2])
+    d = jnp.uint32(MD5_INIT[3])
+    a0, b0, c0, d0 = a, b, c, d
+    for i in range(64):
+        if i < 16:
+            f = (b & c) | (~b & d)
+            g = i
+        elif i < 32:
+            f = (d & b) | (~d & c)
+            g = (5 * i + 1) % 16
+        elif i < 48:
+            f = b ^ c ^ d
+            g = (3 * i + 5) % 16
+        else:
+            f = c ^ (b | ~d)
+            g = (7 * i) % 16
+        m = words[g]
+        if not hasattr(m, "dtype"):
+            m = jnp.uint32(m)
+        f = f + a + jnp.uint32(MD5_K[i]) + m
+        a, d, c = d, c, b
+        b = b + _rotl(f, MD5_S[i])
+    return (a0 + a, b0 + b, c0 + c, d0 + d)
+
+
+def build_pallas_search_step(
+    nonce: bytes,
+    width: int,
+    difficulty: int,
+    tb_lo: int,
+    tb_count: int,
+    chunks_per_step: int,
+    model_name: str = "md5",
+    extra_const_chunk: bytes = b"",
+    sublanes: int = DEFAULT_SUBLANES,
+    interpret: bool = False,
+) -> Callable:
+    """Build ``step(chunk0) -> uint32`` backed by the Pallas kernel.
+
+    Same contract as ``ops.search_step.build_search_step``.  Requires
+    ``tb_count`` to be a power of two and the MD5 model with a single-block
+    tail (the overwhelmingly common configuration); callers fall back to
+    the XLA path otherwise.
+    """
+    model = get_hash_model(model_name)
+    if model.name != "md5":
+        raise ValueError("pallas kernel currently implements the md5 model")
+    if tb_count & (tb_count - 1):
+        raise ValueError("pallas kernel requires power-of-two tb_count")
+
+    spec = build_tail_spec(bytes(nonce), width, model, extra_const_chunk)
+    if spec.n_blocks != 1:
+        raise ValueError("pallas kernel requires a single-block tail")
+    masks = nibble_masks(difficulty, model)
+
+    batch = chunks_per_step * tb_count
+    tile = sublanes * LANES
+    if batch % tile:
+        raise ValueError(f"batch {batch} not a multiple of tile {tile}")
+    grid = batch // tile
+    tb_shift = tb_count.bit_length() - 1  # log2(tb_count)
+
+    base = spec.base_words[0]
+    tb_b, tb_w, tb_s = spec.tb_loc
+
+    def kernel(chunk0_ref, out_ref):
+        i = pl.program_id(0)
+        chunk0 = chunk0_ref[0]
+        row = jax.lax.broadcasted_iota(jnp.uint32, (sublanes, LANES), 0)
+        col = jax.lax.broadcasted_iota(jnp.uint32, (sublanes, LANES), 1)
+        f = (
+            jnp.uint32(i) * jnp.uint32(tile)
+            + row * jnp.uint32(LANES)
+            + col
+        )
+        chunk = chunk0 + (f >> tb_shift)
+        tb = jnp.uint32(tb_lo) + (f & jnp.uint32(tb_count - 1))
+
+        words = list(base)
+        words[tb_w] = jnp.uint32(words[tb_w]) | (tb << tb_s)
+        for j, (_, w_i, s_i) in enumerate(spec.chunk_locs):
+            byte_j = (chunk >> (8 * j)) & jnp.uint32(0xFF)
+            cur = words[w_i]
+            cur = jnp.uint32(cur) if not hasattr(cur, "dtype") else cur
+            words[w_i] = cur | (byte_j << s_i)
+
+        a, b, c, d = _md5_tile(words)
+        acc = None
+        for wd, m in zip((a, b, c, d), masks):
+            if m == 0:
+                continue
+            term = wd & jnp.uint32(m)
+            acc = term if acc is None else (acc | term)
+        hit = (acc == 0) if acc is not None else jnp.ones(f.shape, bool)
+        # Mosaic has no unsigned-integer reductions; flat indices are far
+        # below 2^31, so reduce in int32 with int32-max as the in-kernel
+        # miss marker and translate back to SENTINEL outside.
+        tile_min = jnp.min(
+            jnp.where(hit, f.astype(jnp.int32), jnp.int32(_I32_MISS))
+        )
+
+        # TPU grid steps run sequentially on the core, so a single SMEM
+        # cell accumulates the global min across the grid.
+        @pl.when(i == 0)
+        def _init():
+            out_ref[0, 0] = tile_min
+
+        @pl.when(i > 0)
+        def _acc():
+            out_ref[0, 0] = jnp.minimum(out_ref[0, 0], tile_min)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(
+            (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def step(chunk0):
+        chunk0 = jnp.asarray(chunk0, jnp.uint32).reshape((1,))
+        m = call(chunk0)[0, 0]
+        return jnp.where(
+            m == jnp.int32(_I32_MISS), jnp.uint32(SENTINEL), m.astype(jnp.uint32)
+        )
+
+    return step
+
+
+@functools.lru_cache(maxsize=64)
+def cached_pallas_search_step(
+    nonce: bytes,
+    width: int,
+    difficulty: int,
+    tb_lo: int,
+    tb_count: int,
+    chunks_per_step: int,
+    model_name: str = "md5",
+    extra_const_chunk: bytes = b"",
+    sublanes: int = DEFAULT_SUBLANES,
+    interpret: bool = False,
+):
+    return build_pallas_search_step(
+        nonce, width, difficulty, tb_lo, tb_count, chunks_per_step,
+        model_name, extra_const_chunk, sublanes, interpret,
+    )

@@ -58,8 +58,17 @@ def _write_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
 
 
 def split_addr(addr: str) -> Tuple[str, int]:
+    """Connect-side parse: a host-less ':port' targets the local host."""
     host, _, port = addr.rpartition(":")
     return host or "127.0.0.1", int(port)
+
+
+def split_bind_addr(addr: str) -> Tuple[str, int]:
+    """Listen-side parse: a host-less ':port' binds all interfaces, like
+    Go's net.Listen — reference configs use bare ':port' addresses
+    (config/coordinator_config.json) and must stay multi-host capable."""
+    host, _, port = addr.rpartition(":")
+    return host, int(port)
 
 
 class RPCServer:
@@ -84,11 +93,11 @@ class RPCServer:
 
     def listen(self, addr: str) -> str:
         """Bind a listener; returns the bound address (resolves ':0')."""
-        host, port = split_addr(addr)
+        host, port = split_bind_addr(addr)
         ls = socket.create_server((host, port), reuse_port=False)
         self._listeners.append(ls)
         bound = ls.getsockname()
-        return f"{host}:{bound[1]}"
+        return f"{host or '127.0.0.1'}:{bound[1]}"
 
     def serve_in_background(self) -> None:
         for ls in self._listeners:

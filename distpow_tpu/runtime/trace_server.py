@@ -27,7 +27,7 @@ import threading
 from typing import Optional
 
 from .config import TracingServerConfig
-from .rpc import _read_frame, split_addr  # same framing as the RPC layer
+from .rpc import _read_frame, split_bind_addr  # same framing as the RPC layer
 from .tracing import format_trace_line
 
 SHIVIZ_HEADER = "(?<host>\\S*) (?<clock>{.*})\\n(?<event>.*)\n\n"
@@ -48,10 +48,10 @@ class TracingServer:
 
     # -- lifecycle ---------------------------------------------------------
     def open(self) -> str:
-        host, port = split_addr(self.config.ServerBind)
+        host, port = split_bind_addr(self.config.ServerBind)
         self._listener = socket.create_server((host, port))
         bound = self._listener.getsockname()
-        return f"{host}:{bound[1]}"
+        return f"{host or '127.0.0.1'}:{bound[1]}"
 
     def accept_forever(self) -> None:
         assert self._listener is not None, "open() first"

@@ -106,11 +106,29 @@ class TCPSink:
         return sock
 
     def emit(self, event: dict) -> None:
+        """Ship one event; a broken connection is retried once and then the
+        event is dropped.  Tracing must never poison the protocol path: a
+        tracing-server restart or hiccup costs trace records, not mining
+        requests."""
         payload = json.dumps(event).encode()
         with self._lock:
-            if self._sock is None:
-                self._sock = self._connect()
-            self._sock.sendall(struct.pack(">I", len(payload)) + payload)
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    self._sock.sendall(
+                        struct.pack(">I", len(payload)) + payload
+                    )
+                    return
+                except OSError:
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt == 1:
+                        return  # drop the event
 
     def close(self) -> None:
         with self._lock:

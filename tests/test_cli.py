@@ -1,0 +1,125 @@
+"""CLI / multi-process tests: config-gen consistency and the full
+reference demo scenario as real OS processes on localhost — the closest
+analogue of actually deploying the reference's five binaries
+(SURVEY.md section 3.5 startup sequence)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from distpow_tpu.cli import config_gen
+from distpow_tpu.runtime.config import (
+    ClientConfig,
+    CoordinatorConfig,
+    TracingServerConfig,
+    WorkerConfig,
+    read_json_config,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_config_gen_consistency(tmp_path):
+    config_gen.main(["--config-dir", str(tmp_path), "--workers", "3", "--seed", "7"])
+    ts = read_json_config(tmp_path / "tracing_server_config.json", TracingServerConfig)
+    coord = read_json_config(tmp_path / "coordinator_config.json", CoordinatorConfig)
+    c1 = read_json_config(tmp_path / "client_config.json", ClientConfig)
+    c2 = read_json_config(tmp_path / "client2_config.json", ClientConfig)
+    w = read_json_config(tmp_path / "worker_config.json", WorkerConfig)
+
+    assert coord.TracerServerAddr == ts.ServerBind
+    assert c1.CoordAddr == coord.ClientAPIListenAddr
+    assert c2.CoordAddr == coord.ClientAPIListenAddr
+    assert c2.ClientID != c1.ClientID
+    assert w.CoordAddr == coord.WorkerAPIListenAddr
+    assert w.ListenAddr == "PASS VIA COMMAND-LINE"
+    assert len(coord.Workers) == 3
+    assert len({ts.ServerBind, coord.ClientAPIListenAddr,
+                coord.WorkerAPIListenAddr, *coord.Workers}) == 6
+    for addr in coord.Workers:
+        port = int(addr.rsplit(":", 1)[1])
+        assert 1024 <= port < 35535
+
+
+def test_stock_configs_load():
+    assert len(read_json_config(REPO / "config/coordinator_config.json",
+                                CoordinatorConfig).Workers) == 4
+    assert read_json_config(REPO / "config/worker_config.json",
+                            WorkerConfig).Backend == "jax"
+    assert read_json_config(REPO / "config/client_config.json",
+                            ClientConfig).ClientID == "client1"
+
+
+@pytest.mark.slow
+def test_multiprocess_demo_scenario(tmp_path):
+    """Boot tracing server + coordinator + 2 workers + demo client as
+    subprocesses, difficulty 2/4 nibbles, python backend (no JAX warmup
+    in the workers keeps this fast)."""
+    config_gen.main(["--config-dir", str(tmp_path), "--workers", "2", "--seed", "123"])
+    # worker backend: python for subprocess speed
+    wcfg = json.loads((tmp_path / "worker_config.json").read_text())
+    wcfg["Backend"] = "python"
+    (tmp_path / "worker_config.json").write_text(json.dumps(wcfg))
+    coord = read_json_config(tmp_path / "coordinator_config.json", CoordinatorConfig)
+    ts_cfg = json.loads((tmp_path / "tracing_server_config.json").read_text())
+    ts_cfg["OutputFile"] = str(tmp_path / "trace_output.log")
+    ts_cfg["ShivizOutputFile"] = str(tmp_path / "shiviz_output.log")
+    (tmp_path / "tracing_server_config.json").write_text(json.dumps(ts_cfg))
+
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU in subprocesses
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn(*args):
+        return subprocess.Popen(
+            [sys.executable, "-m", *args],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    procs = []
+    try:
+        procs.append(spawn("distpow_tpu.cli.tracing_server",
+                           "--config", str(tmp_path / "tracing_server_config.json")))
+        time.sleep(0.5)
+        procs.append(spawn("distpow_tpu.cli.coordinator",
+                           "--config", str(tmp_path / "coordinator_config.json")))
+        time.sleep(0.5)
+        for i, addr in enumerate(coord.Workers):
+            procs.append(spawn("distpow_tpu.cli.worker",
+                               "--config", str(tmp_path / "worker_config.json"),
+                               "--id", f"worker{i + 1}", "--listen", addr))
+        time.sleep(0.5)
+
+        client = spawn("distpow_tpu.cli.client",
+                       "--config", str(tmp_path / "client_config.json"),
+                       "--config2", str(tmp_path / "client2_config.json"),
+                       "--difficulty", "2")
+        out, _ = client.communicate(timeout=120)
+        assert client.returncode == 0, out
+        assert out.count("MineResult") == 4, out
+
+        time.sleep(0.5)
+        trace_log = (tmp_path / "trace_output.log").read_text()
+        for marker in ("PowlibMiningBegin", "CoordinatorMine", "WorkerMine",
+                       "WorkerResult", "CoordinatorSuccess",
+                       "PowlibMiningComplete", "[client1]", "[client2]",
+                       "[coordinator]", "[worker1]", "[worker2]"):
+            assert marker in trace_log, f"missing {marker}"
+        shiviz = (tmp_path / "shiviz_output.log").read_text()
+        assert shiviz.startswith("(?<host>")
+        assert "coordinator {" in shiviz
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -1,0 +1,68 @@
+"""Native C++ miner tests (builds the shared library on first use)."""
+
+import hashlib
+import threading
+
+import pytest
+
+from distpow_tpu.models import puzzle
+
+native = pytest.importorskip("distpow_tpu.backends.native_miner")
+
+try:
+    native.load_library()
+    HAVE_NATIVE = True
+except native.NativeUnavailable:
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(
+    not HAVE_NATIVE, reason="native miner could not be built"
+)
+
+
+@pytest.mark.parametrize("length", [0, 1, 8, 55, 56, 63, 64, 65, 130])
+def test_native_md5_vs_hashlib(length):
+    import random
+
+    rng = random.Random(length)
+    data = bytes(rng.randrange(256) for _ in range(length))
+    assert native.native_md5(data) == hashlib.md5(data).digest()
+
+
+def test_native_backend_matches_oracle_single_thread():
+    backend = native.NativeBackend(n_threads=1)
+    for nonce in (b"\x01\x02\x03\x04", b"\xaa\xbb"):
+        for difficulty in (1, 2, 3):
+            tbs = list(range(256))
+            secret = backend.search(nonce, difficulty, tbs)
+            assert secret == puzzle.python_search(nonce, difficulty, tbs)
+
+
+def test_native_backend_subpartition():
+    backend = native.NativeBackend(n_threads=1)
+    tbs = list(range(192, 256))
+    secret = backend.search(b"\x05\x06", 2, tbs)
+    assert secret is not None and secret[0] in tbs
+    assert secret == puzzle.python_search(b"\x05\x06", 2, tbs)
+
+
+def test_native_backend_multithreaded_valid():
+    backend = native.NativeBackend(n_threads=4)
+    secret = backend.search(b"\x31\x41\x59", 3, list(range(256)))
+    assert secret is not None
+    assert puzzle.check_secret(b"\x31\x41\x59", secret, 3)
+
+
+def test_native_backend_long_nonce_multiblock():
+    backend = native.NativeBackend(n_threads=1)
+    nonce = bytes(range(150))
+    secret = backend.search(nonce, 2, list(range(256)))
+    assert secret == puzzle.python_search(nonce, 2, list(range(256)))
+
+
+def test_native_backend_cancellation():
+    backend = native.NativeBackend(n_threads=2, range_size=1 << 18)
+    ev = threading.Event()
+    threading.Timer(0.2, ev.set).start()
+    secret = backend.search(b"\x01", 30, list(range(256)), cancel_check=ev.is_set)
+    assert secret is None

@@ -1,0 +1,320 @@
+"""End-to-end protocol tests: client -> coordinator -> workers over real RPC.
+
+In-process analogue of the reference's multi-node-on-localhost validation
+(SURVEY.md section 4): every node runs with its own MemorySink tracer so
+the causal action sequences — the reference's correctness oracle — can be
+asserted directly.  Boot order mirrors cmd/* (coordinator, then workers,
+then clients; SURVEY.md section 3.5).
+"""
+
+import queue
+import socket
+import threading
+import time
+
+import pytest
+
+from distpow_tpu.models import puzzle
+from distpow_tpu.nodes import Client, Coordinator, Worker
+from distpow_tpu.runtime.config import ClientConfig, CoordinatorConfig, WorkerConfig
+from distpow_tpu.runtime.tracing import MemorySink
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Stack:
+    """coordinator + N workers + client(s), each with a MemorySink."""
+
+    def __init__(self, n_workers: int, backend: str = "python", difficulty_model="md5"):
+        coord_client_port = free_port()
+        coord_worker_port = free_port()
+        worker_ports = [free_port() for _ in range(n_workers)]
+
+        self.sinks = {"coordinator": MemorySink()}
+        self.coordinator = Coordinator(
+            CoordinatorConfig(
+                ClientAPIListenAddr=f"127.0.0.1:{coord_client_port}",
+                WorkerAPIListenAddr=f"127.0.0.1:{coord_worker_port}",
+                Workers=[f"127.0.0.1:{p}" for p in worker_ports],
+            ),
+            sink=self.sinks["coordinator"],
+        )
+        self.coordinator.initialize_rpcs()
+
+        self.workers = []
+        for i, p in enumerate(worker_ports):
+            wid = f"worker{i + 1}"
+            self.sinks[wid] = MemorySink()
+            w = Worker(
+                WorkerConfig(
+                    WorkerID=wid,
+                    ListenAddr=f"127.0.0.1:{p}",
+                    CoordAddr=f"127.0.0.1:{coord_worker_port}",
+                    Backend=backend,
+                    HashModel=difficulty_model,
+                ),
+                sink=self.sinks[wid],
+            )
+            w.initialize_rpcs()
+            w.start_forwarder()
+            self.workers.append(w)
+
+        self.coord_client_addr = f"127.0.0.1:{coord_client_port}"
+        self.clients = []
+
+    def new_client(self, cid: str) -> Client:
+        self.sinks[cid] = MemorySink()
+        c = Client(
+            ClientConfig(ClientID=cid, CoordAddr=self.coord_client_addr),
+            sink=self.sinks[cid],
+        )
+        c.initialize()
+        self.clients.append(c)
+        return c
+
+    def close(self):
+        for c in self.clients:
+            c.close()
+        for w in self.workers:
+            w.shutdown()
+        self.coordinator.shutdown()
+
+    def action_names(self, node: str):
+        return [a[1] for a in self.sinks[node].actions()]
+
+
+@pytest.fixture
+def stack1():
+    s = Stack(1)
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def stack4():
+    s = Stack(4)
+    yield s
+    s.close()
+
+
+def mine_and_wait(client: Client, nonce: bytes, ntz: int, timeout=30):
+    client.mine(nonce, ntz)
+    return client.notify_queue.get(timeout=timeout)
+
+
+def test_single_worker_end_to_end(stack1):
+    client = stack1.new_client("client1")
+    res = mine_and_wait(client, b"\x01\x02\x03\x04", 2)
+    assert res.nonce == b"\x01\x02\x03\x04"
+    assert res.num_trailing_zeros == 2
+    assert puzzle.check_secret(res.nonce, res.secret, 2)
+    # the result equals the reference-order first match for the full range
+    oracle = puzzle.python_search(b"\x01\x02\x03\x04", 2, list(range(256)))
+    assert res.secret == oracle
+
+    # client trace ordering (powlib.go:106-176)
+    assert stack1.action_names("client1") == [
+        "PowlibMiningBegin", "PowlibMine", "PowlibSuccess", "PowlibMiningComplete",
+    ]
+    # coordinator protocol spine (coordinator.go:139-298)
+    coord = stack1.action_names("coordinator")
+    assert coord[0] == "CoordinatorMine"
+    assert coord[1] == "CacheMiss"
+    assert "CoordinatorWorkerMine" in coord
+    assert "CoordinatorWorkerResult" in coord
+    assert "CoordinatorWorkerCancel" in coord
+    assert coord[-1] == "CoordinatorSuccess"
+    # CacheAdd happens when the worker result arrives
+    assert "CacheAdd" in coord
+    # worker: Mine -> (CacheMiss) -> Result -> Cancel last (worker.go:375-387)
+    wk = stack1.action_names("worker1")
+    assert wk[0] == "WorkerMine"
+    assert "WorkerResult" in wk
+    assert wk[-1] == "WorkerCancel"
+    assert wk.index("WorkerResult") < wk.index("WorkerCancel")
+
+
+def test_four_workers_partition_and_ledger(stack4):
+    client = stack4.new_client("client1")
+    res = mine_and_wait(client, b"\x05\x06\x07\x08", 2)
+    assert puzzle.check_secret(res.nonce, res.secret, 2)
+
+    coord = stack4.action_names("coordinator")
+    # fan-out recorded one CoordinatorWorkerMine per worker
+    assert coord.count("CoordinatorWorkerMine") == 4
+    # cancel broadcast >= one per worker (more if late results re-broadcast)
+    assert coord.count("CoordinatorWorkerCancel") % 4 == 0
+    assert coord.count("CoordinatorWorkerCancel") >= 4
+    # every worker saw the Mine and recorded a Cancel; a WorkerResult (if
+    # any) precedes the first WorkerCancel after it.  (The strict
+    # "WorkerCancel last" only holds without late-result re-broadcasts,
+    # whose no-task path appends WorkerCancel + CacheAdd, worker.go:215-221.)
+    for i in range(4):
+        wk = stack4.action_names(f"worker{i + 1}")
+        assert wk[0] == "WorkerMine"
+        assert "WorkerCancel" in wk
+        if "WorkerResult" in wk:
+            r = wk.index("WorkerResult")
+            assert "WorkerCancel" in wk[r:]
+    # the Mine RPC returned (ledger complete) and the system is idle enough
+    # for a second request to run cleanly
+    res2 = mine_and_wait(client, b"\x09\x0a", 2)
+    assert puzzle.check_secret(res2.nonce, res2.secret, 2)
+
+
+def test_winning_secret_lands_in_all_caches(stack4):
+    client = stack4.new_client("client1")
+    res = mine_and_wait(client, b"\x11\x12", 2)
+    time.sleep(0.3)  # Found broadcast completes before Mine returns; margin
+    for i in range(4):
+        entry = stack4.workers[i].handler.result_cache.peek(b"\x11\x12")
+        assert entry is not None
+        # every worker cache converged to a secret >= the winner in the
+        # dominance order (late results may dominate the first winner)
+        assert entry.num_trailing_zeros >= 2
+    coord_entry = stack4.coordinator.handler.result_cache.peek(b"\x11\x12")
+    assert coord_entry is not None
+
+
+def test_cache_hit_skips_fanout(stack1):
+    client = stack1.new_client("client1")
+    mine_and_wait(client, b"\x21\x22", 2)
+    coord_before = stack1.action_names("coordinator")
+    n_mines = coord_before.count("CoordinatorWorkerMine")
+
+    res2 = mine_and_wait(client, b"\x21\x22", 2)
+    assert puzzle.check_secret(res2.nonce, res2.secret, 2)
+    coord_after = stack1.action_names("coordinator")
+    # no new fan-out; the hit path records CacheHit then CoordinatorSuccess
+    assert coord_after.count("CoordinatorWorkerMine") == n_mines
+    assert coord_after[-2:] == ["CacheHit", "CoordinatorSuccess"]
+
+
+def test_dominance_supersede_demo_scenario(stack1):
+    # the reference demo's interesting pair: same nonce at difficulty 2
+    # then 3 (cmd/client/main.go:46-51 uses 5 then 7) — a cached 2-zeros
+    # secret must NOT satisfy the 3-zeros request, whose result then
+    # replaces it (coordinator.go:403,436)
+    client = stack1.new_client("client1")
+    nonce = b"\x02\x02\x02\x02"
+    r1 = mine_and_wait(client, nonce, 2)
+    r2 = mine_and_wait(client, nonce, 3)
+    assert puzzle.check_secret(nonce, r2.secret, 3)
+    coord = stack1.action_names("coordinator")
+    # second request missed (2 < 3) and re-mined
+    assert coord.count("CoordinatorWorkerMine") == 2
+    entry = stack1.coordinator.handler.result_cache.peek(nonce)
+    assert entry.num_trailing_zeros >= 3
+    # the lower-difficulty entry was removed in favor of the higher one
+    assert "CacheRemove" in coord
+
+
+def test_two_clients_concurrent_demo(stack4):
+    # the reference's built-in smoke scenario: two clients, four requests,
+    # including a repeated nonce at increasing difficulty
+    # (cmd/client/main.go:40-60)
+    c1 = stack4.new_client("client1")
+    c2 = stack4.new_client("client2")
+    c1.mine(b"\x01\x02\x03\x04", 3)
+    c1.mine(b"\x05\x06\x07\x08", 2)
+    c2.mine(b"\x02\x02\x02\x02", 2)
+    c2.mine(b"\x02\x02\x02\x02", 3)
+
+    results = []
+    for _ in range(4):
+        got = None
+        for c in (c1, c2):
+            try:
+                got = c.notify_queue.get(timeout=0.05)
+                break
+            except queue.Empty:
+                continue
+        if got is None:
+            time.sleep(0.1)
+            continue
+        results.append(got)
+    deadline = time.time() + 60
+    while len(results) < 4 and time.time() < deadline:
+        for c in (c1, c2):
+            try:
+                results.append(c.notify_queue.get(timeout=0.2))
+            except queue.Empty:
+                pass
+    assert len(results) == 4
+    for r in results:
+        assert puzzle.check_secret(r.nonce, r.secret, r.num_trailing_zeros)
+
+
+def test_late_result_rebroadcast_via_warm_caches(stack4):
+    # Warm every worker cache, then issue the same puzzle again: all four
+    # workers answer from cache immediately -> one winner + three late
+    # results -> the coordinator re-broadcasts Found per late result and
+    # drains N acks each (coordinator.go:237-280)
+    client = stack4.new_client("client1")
+    nonce = b"\x31\x32"
+    mine_and_wait(client, nonce, 2)
+    time.sleep(0.3)
+
+    # clear the coordinator cache so the request fans out again, but keep
+    # worker caches warm
+    stack4.coordinator.handler.result_cache._entries.clear()
+    res = mine_and_wait(client, nonce, 2)
+    assert puzzle.check_secret(nonce, res.secret, 2)
+    coord = stack4.action_names("coordinator")
+    # at least one late CoordinatorWorkerResult beyond the winner
+    assert coord.count("CoordinatorWorkerResult") >= 2
+    # re-broadcast rounds: cancels are a multiple of 4 and > 4
+    assert coord.count("CoordinatorWorkerCancel") % 4 == 0
+    assert coord.count("CoordinatorWorkerCancel") > 4
+    # ledger completed: follow-up request still works
+    res3 = mine_and_wait(client, b"\x41\x42", 2)
+    assert puzzle.check_secret(b"\x41\x42", res3.secret, 2)
+
+
+def test_duplicate_concurrent_mine_same_key(stack1):
+    # documented fix for coordinator.go:376-381: two concurrent Mine
+    # requests for the same (nonce, zeros) must both complete
+    client = stack1.new_client("client1")
+    nonce = b"\x51\x52"
+    client.mine(nonce, 3)
+    client.mine(nonce, 3)
+    r1 = client.notify_queue.get(timeout=60)
+    r2 = client.notify_queue.get(timeout=60)
+    for r in (r1, r2):
+        assert puzzle.check_secret(nonce, r.secret, 3)
+
+
+def test_worker_cache_hit_path_trace(stack1):
+    client = stack1.new_client("client1")
+    nonce = b"\x61\x62"
+    mine_and_wait(client, nonce, 2)
+    time.sleep(0.2)
+    # clear coordinator cache; worker cache stays warm -> miner cache-hit
+    # path (worker.go:260-299): CacheHit then WorkerResult then WorkerCancel
+    stack1.coordinator.handler.result_cache._entries.clear()
+    mine_and_wait(client, nonce, 2)
+    wk = stack1.action_names("worker1")
+    hit = wk.index("CacheHit")
+    assert "WorkerResult" in wk[hit:]
+    assert wk[-1] == "WorkerCancel"
+
+
+def test_trace_tokens_cross_all_nodes(stack1):
+    # one request's trace id must appear at client, coordinator, and worker
+    client = stack1.new_client("client1")
+    mine_and_wait(client, b"\x71\x72", 2)
+    tid = {e["trace_id"] for e in stack1.sinks["client1"].events
+           if e["type"] == "action"}
+    assert len(tid) == 1
+    tid = tid.pop()
+    coord_tids = {e["trace_id"] for e in stack1.sinks["coordinator"].events
+                  if e["type"] == "action"}
+    worker_tids = {e["trace_id"] for e in stack1.sinks["worker1"].events
+                   if e["type"] == "action"}
+    assert tid in coord_tids and tid in worker_tids
