@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import queue
 
 from ..nodes.client import Client
@@ -23,7 +24,11 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description="distpow demo client")
     ap.add_argument("--config", default="config/client_config.json")
-    ap.add_argument("--config2", default="config/client2_config.json")
+    ap.add_argument(
+        "--config2",
+        help="second client's config (default: client2_config.json next to "
+        "--config, falling back to --config with ClientID 'client2')",
+    )
     ap.add_argument("--id", help="Client ID override")
     ap.add_argument("--id2", help="Second client ID override")
     ap.add_argument(
@@ -33,7 +38,18 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     cfg1 = read_json_config(args.config, ClientConfig)
-    cfg2 = read_json_config(args.config2, ClientConfig)
+    config2, reused_cfg1 = args.config2, False
+    if config2 is None:
+        sibling = os.path.join(
+            os.path.dirname(args.config), "client2_config.json"
+        )
+        if os.path.exists(sibling):
+            config2 = sibling
+        else:
+            config2, reused_cfg1 = args.config, True
+    cfg2 = read_json_config(config2, ClientConfig)
+    if reused_cfg1 and not args.id2:
+        cfg2.ClientID = "client2"
     if args.id:
         cfg1.ClientID = args.id
     if args.id2:
