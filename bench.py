@@ -59,7 +59,7 @@ def main() -> None:
     print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
 
     from distpow_tpu.models.registry import get_hash_model
-    from distpow_tpu.ops.search_step import build_search_step
+    from distpow_tpu.ops.search_step import build_search_step, cached_search_step
 
     model = get_hash_model("md5")
     nonce = b"\x01\x02\x03\x04"
@@ -72,7 +72,17 @@ def main() -> None:
         )
         return step, chunks * 256
 
-    rates = {"xla": device_rate(xla_builder, "xla fused step")}
+    def xla_dyn_builder():
+        # the serving path: nonce/difficulty/partition are runtime operands
+        step = cached_search_step(
+            nonce, 4, difficulty, 0, 256, chunks, model.name
+        )
+        return step, chunks * 256
+
+    rates = {
+        "xla": device_rate(xla_builder, "xla fused step"),
+        "xla-dyn": device_rate(xla_dyn_builder, "xla dynamic (serving) step"),
+    }
 
     try:
         from distpow_tpu.ops.md5_pallas import build_pallas_search_step
@@ -90,22 +100,29 @@ def main() -> None:
     best_label = max(rates, key=rates.get)
     best = rates[best_label]
 
-    # sanity: a real end-to-end solve at difficulty 6 nibbles (24 bits,
-    # BASELINE.md config 3) — wall-clock includes driver + verification
+    # end-to-end wall-clock to first valid nonce (BASELINE.md's second
+    # metric): warm the layout-keyed programs the way a booted worker does
+    # (WorkerConfig.WarmupNonceLens), then solve fresh nonces at 24-bit
+    # difficulty — steady-state serving latency, driver + verification
+    # included.
     try:
+        from distpow_tpu.backends import JaxBackend
         from distpow_tpu.models import puzzle
-        from distpow_tpu.parallel.search import search
 
+        backend = JaxBackend(batch_size=1 << 21)
         t0 = time.time()
-        res = search(b"\x13\x57\x9b\xdf", 6, list(range(256)),
-                     batch_size=1 << 21)
-        dt = time.time() - t0
-        assert res is not None
-        assert puzzle.check_secret(b"\x13\x57\x9b\xdf", res.secret, 6)
-        print(f"[bench] e2e diff=24bit solve: secret={res.secret.hex()} "
-              f"after {res.hashes_tried / 1e6:.1f}M hashes in {dt:.2f}s "
-              f"({res.hashes_tried / dt / 1e6:.1f} MH/s incl. overhead)",
-              file=sys.stderr)
+        backend.warmup([4], [0, 1, 2, 3])
+        print(f"[bench] worker warmup (len-4 nonces, widths 0-3): "
+              f"{time.time() - t0:.1f}s one-time", file=sys.stderr)
+        for nonce_e2e in (b"\x13\x57\x9b\xdf", b"\x24\x68\xac\xe0"):
+            t0 = time.time()
+            secret = backend.search(nonce_e2e, 6, list(range(256)))
+            dt = time.time() - t0
+            assert secret is not None
+            assert puzzle.check_secret(nonce_e2e, secret, 6)
+            print(f"[bench] e2e diff=24bit solve of {nonce_e2e.hex()}: "
+                  f"secret={secret.hex()} in {dt:.2f}s wall-clock",
+                  file=sys.stderr)
     except Exception as exc:
         print(f"[bench] e2e solve failed: {exc}", file=sys.stderr)
 

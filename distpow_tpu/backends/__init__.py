@@ -21,10 +21,13 @@ reference enumeration order, or None when cancelled.
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Optional, Sequence
 
 from ..models import puzzle
 from ..models.registry import get_hash_model
+
+log = logging.getLogger("distpow.backends")
 
 
 class PythonBackend:
@@ -46,6 +49,13 @@ class PythonBackend:
         )
 
 
+def _warm_factory(factory, widths, target_chunks) -> None:
+    """Compile-and-dispatch each width's step once (tiny real launch)."""
+    for vw in widths:
+        step, _ = factory(int(vw), b"", target_chunks)
+        int(step(1))  # block_until_ready via the int() conversion
+
+
 class JaxBackend:
     """Single-device fused-step search (the TPU path)."""
 
@@ -54,6 +64,21 @@ class JaxBackend:
     def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20, **_):
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
+
+    def warmup(self, nonce_lens: Sequence[int], widths: Sequence[int]) -> None:
+        """Pre-compile the layout-keyed programs these nonce lengths hit.
+
+        The dynamic regime (ops/search_step.py) keys compiles on (tail
+        layout, batch) only, so warming with a zero nonce of the right
+        length and the full 256-byte partition covers every future nonce
+        of that length at any difficulty and any power-of-two partition.
+        """
+        from ..parallel.search import default_step_factory, effective_batch
+
+        for L in nonce_lens:
+            factory = default_step_factory(bytes(int(L)), 1, 0, 256, self.model)
+            _warm_factory(factory, widths,
+                          max(1, effective_batch(self.batch_size) // 256))
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
         from ..parallel.search import search
@@ -97,6 +122,25 @@ class JaxMeshBackend:
                 devs = devs[: self.mesh_devices]
             self._mesh = make_mesh(devs)
         return self._mesh
+
+    def warmup(self, nonce_lens: Sequence[int], widths: Sequence[int]) -> None:
+        from ..parallel.mesh_search import AXIS, _mesh_step_factory
+        from ..parallel.search import effective_batch
+
+        n_dev = int(self._get_mesh().devices.size)
+        if n_dev & (n_dev - 1):
+            # non-power-of-two mesh: the factory compiles nonce-content-
+            # keyed static programs that cannot be reused by later
+            # requests — warming them would burn compile time for nothing
+            log.info("mesh warmup skipped: %d devices (not a power of two)",
+                     n_dev)
+            return
+        for L in nonce_lens:
+            factory = _mesh_step_factory(
+                bytes(int(L)), 1, 0, 256, self.model, self._get_mesh(), AXIS
+            )
+            _warm_factory(factory, widths,
+                          max(1, effective_batch(self.batch_size) // 256))
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
         from ..parallel.mesh_search import search_mesh

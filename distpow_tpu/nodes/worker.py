@@ -205,6 +205,26 @@ class Worker:
         self.server.register("WorkerRPCHandler", self.handler)
         self.bound_addr: Optional[str] = None
         self._forwarder: Optional[threading.Thread] = None
+        self._start_warmup(backend)
+
+    def _start_warmup(self, backend) -> None:
+        """Background-compile the layout-keyed search programs at boot so
+        the first Mine RPC is pure dispatch (the reference has no compile
+        step to hide; XLA does — see WorkerConfig.WarmupNonceLens)."""
+        lens = list(self.config.WarmupNonceLens or [])
+        widths = list(self.config.WarmupWidths or [])
+        if not lens or not widths or not hasattr(backend, "warmup"):
+            return
+
+        def warm():
+            try:
+                backend.warmup(lens, widths)
+                log.info("%s: warmup done (%d layouts)",
+                         self.config.WorkerID, len(lens) * len(widths))
+            except Exception as exc:  # warmup is best-effort
+                log.warning("%s: warmup failed: %s", self.config.WorkerID, exc)
+
+        threading.Thread(target=warm, daemon=True).start()
 
     def initialize_rpcs(self) -> str:
         self.bound_addr = self.server.listen(self.config.ListenAddr)

@@ -3,10 +3,19 @@
 The hot op of the framework (SURVEY.md section 7 layer 4, the "north
 star"): one kernel launch evaluates a dense tile grid of candidates —
 index -> message words -> 64 MD5 rounds -> trailing-nibble mask -> argmin
-— entirely in VMEM/registers.  Nothing but one uint32 scalar (the chunk
-base) enters the kernel and one uint32 per grid tile (the tile's first-hit
-flat index, or SENTINEL) leaves it; candidate messages are never
-materialized anywhere, not even in HBM.
+— entirely in VMEM/registers.  Only scalars enter the kernel (the chunk
+base, the nonce's packed constant words, the absorbed init state, the
+difficulty masks, and the partition descriptor — all in SMEM) and one
+uint32 per grid tile leaves it; candidate messages are never materialized
+anywhere, not even in HBM.
+
+Compilation is *layout-keyed*: the kernel program depends only on the
+tail-byte layout (where the thread byte and chunk bytes land in the
+16-word block — a function of nonce length mod 64 and chunk width) and
+the batch geometry.  The nonce content, difficulty, and thread-byte
+partition are runtime SMEM operands, so a worker compiles each layout
+once and serves every subsequent request at any difficulty/partition with
+zero recompiles (mirroring ops/search_step.py's dynamic regime).
 
 Layout: each grid step processes a (SUBLANES, 128) tile of flat candidate
 indices (uint32 native tile is (8, 128); SUBLANES is a multiple of 8).
@@ -31,7 +40,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..models.md5_jax import MD5_INIT, MD5_K, MD5_S
+from ..models.md5_jax import MD5_K, MD5_S
 from ..models.registry import get_hash_model
 from .difficulty import nibble_masks
 from .packing import build_tail_spec
@@ -46,13 +55,10 @@ def _rotl(x, s: int):
     return (x << s) | (x >> (32 - s))
 
 
-def _md5_tile(words):
-    """Unrolled 64-round MD5 on a tile; ``words[g]`` is an array or int."""
-    a = jnp.uint32(MD5_INIT[0])
-    b = jnp.uint32(MD5_INIT[1])
-    c = jnp.uint32(MD5_INIT[2])
-    d = jnp.uint32(MD5_INIT[3])
-    a0, b0, c0, d0 = a, b, c, d
+def _md5_tile(words, init):
+    """Unrolled 64-round MD5 on a tile; ``words[g]`` is an array or scalar."""
+    a0, b0, c0, d0 = init
+    a, b, c, d = a0, b0, c0, d0
     for i in range(64):
         if i < 16:
             f = (b & c) | (~b & d)
@@ -73,6 +79,91 @@ def _md5_tile(words):
         a, d, c = d, c, b
         b = b + _rotl(f, MD5_S[i])
     return (a0 + a, b0 + b, c0 + c, d0 + d)
+
+
+@functools.lru_cache(maxsize=None)
+def _dyn_pallas_step(
+    tb_word: int,
+    tb_shift_in_word: int,
+    chunk_word_shifts,  # tuple of (word, shift) per little-endian chunk byte
+    grid: int,
+    sublanes: int,
+    interpret: bool,
+):
+    """Layout-keyed pallas program.
+
+    Returned jitted fn: ``(chunk0, init[4], base[16], masks[4],
+    part[2]=(tb_lo, log_tbc)) -> uint32`` (flat first-hit index or
+    SENTINEL).
+    """
+    tile = sublanes * LANES
+
+    def kernel(chunk0_ref, init_ref, base_ref, masks_ref, part_ref, out_ref):
+        i = pl.program_id(0)
+        chunk0 = chunk0_ref[0]
+        tb_lo = part_ref[0]
+        log_tbc = part_ref[1]
+        row = jax.lax.broadcasted_iota(jnp.uint32, (sublanes, LANES), 0)
+        col = jax.lax.broadcasted_iota(jnp.uint32, (sublanes, LANES), 1)
+        f = (
+            jnp.uint32(i) * jnp.uint32(tile)
+            + row * jnp.uint32(LANES)
+            + col
+        )
+        chunk = chunk0 + (f >> log_tbc)
+        tb = tb_lo + (f & ((jnp.uint32(1) << log_tbc) - jnp.uint32(1)))
+
+        words = [base_ref[w] for w in range(16)]
+        words[tb_word] = words[tb_word] | (tb << tb_shift_in_word)
+        for j, (w_i, s_i) in enumerate(chunk_word_shifts):
+            byte_j = (chunk >> jnp.uint32(8 * j)) & jnp.uint32(0xFF)
+            words[w_i] = words[w_i] | (byte_j << s_i)
+
+        a, b, c, d = _md5_tile(
+            words, (init_ref[0], init_ref[1], init_ref[2], init_ref[3])
+        )
+        acc = (
+            (a & masks_ref[0]) | (b & masks_ref[1])
+            | (c & masks_ref[2]) | (d & masks_ref[3])
+        )
+        hit = acc == jnp.uint32(0)
+        # Mosaic has no unsigned-integer reductions; flat indices are far
+        # below 2^31, so reduce in int32 with int32-max as the in-kernel
+        # miss marker and translate back to SENTINEL outside.
+        tile_min = jnp.min(
+            jnp.where(hit, f.astype(jnp.int32), jnp.int32(_I32_MISS))
+        )
+
+        # TPU grid steps run sequentially on the core, so a single SMEM
+        # cell accumulates the global min across the grid.
+        @pl.when(i == 0)
+        def _init():
+            out_ref[0, 0] = tile_min
+
+        @pl.when(i > 0)
+        def _acc():
+            out_ref[0, 0] = jnp.minimum(out_ref[0, 0], tile_min)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 5,
+        out_specs=pl.BlockSpec(
+            (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def step(chunk0, init, base, masks, part):
+        chunk0 = jnp.asarray(chunk0, jnp.uint32).reshape((1,))
+        m = call(chunk0, init, base, masks, part)[0, 0]
+        return jnp.where(
+            m == jnp.int32(_I32_MISS), jnp.uint32(SENTINEL), m.astype(jnp.uint32)
+        )
+
+    return step
 
 
 def build_pallas_search_step(
@@ -110,80 +201,23 @@ def build_pallas_search_step(
     if batch % tile:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
     grid = batch // tile
-    tb_shift = tb_count.bit_length() - 1  # log2(tb_count)
 
-    base = spec.base_words[0]
-    tb_b, tb_w, tb_s = spec.tb_loc
+    _, tb_w, tb_s = spec.tb_loc
+    chunk_ws = tuple((w, s) for _, w, s in spec.chunk_locs)
+    dyn = _dyn_pallas_step(tb_w, tb_s, chunk_ws, grid, sublanes, interpret)
 
-    def kernel(chunk0_ref, out_ref):
-        i = pl.program_id(0)
-        chunk0 = chunk0_ref[0]
-        row = jax.lax.broadcasted_iota(jnp.uint32, (sublanes, LANES), 0)
-        col = jax.lax.broadcasted_iota(jnp.uint32, (sublanes, LANES), 1)
-        f = (
-            jnp.uint32(i) * jnp.uint32(tile)
-            + row * jnp.uint32(LANES)
-            + col
-        )
-        chunk = chunk0 + (f >> tb_shift)
-        tb = jnp.uint32(tb_lo) + (f & jnp.uint32(tb_count - 1))
+    init = jnp.asarray(spec.init_state, jnp.uint32)
+    base = jnp.asarray(spec.base_words[0], jnp.uint32)
+    masks_arr = jnp.asarray(masks, jnp.uint32)
+    part = jnp.asarray([tb_lo, tb_count.bit_length() - 1], jnp.uint32)
 
-        words = list(base)
-        words[tb_w] = jnp.uint32(words[tb_w]) | (tb << tb_s)
-        for j, (_, w_i, s_i) in enumerate(spec.chunk_locs):
-            byte_j = (chunk >> (8 * j)) & jnp.uint32(0xFF)
-            cur = words[w_i]
-            cur = jnp.uint32(cur) if not hasattr(cur, "dtype") else cur
-            words[w_i] = cur | (byte_j << s_i)
-
-        a, b, c, d = _md5_tile(words)
-        acc = None
-        for wd, m in zip((a, b, c, d), masks):
-            if m == 0:
-                continue
-            term = wd & jnp.uint32(m)
-            acc = term if acc is None else (acc | term)
-        hit = (acc == 0) if acc is not None else jnp.ones(f.shape, bool)
-        # Mosaic has no unsigned-integer reductions; flat indices are far
-        # below 2^31, so reduce in int32 with int32-max as the in-kernel
-        # miss marker and translate back to SENTINEL outside.
-        tile_min = jnp.min(
-            jnp.where(hit, f.astype(jnp.int32), jnp.int32(_I32_MISS))
-        )
-
-        # TPU grid steps run sequentially on the core, so a single SMEM
-        # cell accumulates the global min across the grid.
-        @pl.when(i == 0)
-        def _init():
-            out_ref[0, 0] = tile_min
-
-        @pl.when(i > 0)
-        def _acc():
-            out_ref[0, 0] = jnp.minimum(out_ref[0, 0], tile_min)
-
-    call = pl.pallas_call(
-        kernel,
-        grid=(grid,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec(
-            (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
-        interpret=interpret,
-    )
-
-    @jax.jit
     def step(chunk0):
-        chunk0 = jnp.asarray(chunk0, jnp.uint32).reshape((1,))
-        m = call(chunk0)[0, 0]
-        return jnp.where(
-            m == jnp.int32(_I32_MISS), jnp.uint32(SENTINEL), m.astype(jnp.uint32)
-        )
+        return dyn(chunk0, init, base, masks_arr, part)
 
     return step
 
 
-@functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=512)
 def cached_pallas_search_step(
     nonce: bytes,
     width: int,

@@ -10,10 +10,22 @@ Reference order is preserved exactly: the flat index is chunk-major,
 thread-byte-minor, matching the nested loop at worker.go:318-319 where all
 thread bytes are tried for each chunk value before the chunk advances.
 
-Everything except the chunk base is static, so each (nonce length, width,
-difficulty, partition, batch) tuple compiles once and is re-dispatched with
-a new ``chunk0`` scalar every step — no recompiles in the steady state, no
-host<->device traffic beyond one scalar in and one scalar out.
+Two compilation regimes:
+
+* ``build_search_step`` bakes everything but the chunk base into the
+  program — maximum constant folding, one compile per (nonce, difficulty,
+  partition, batch).  Used where one configuration is re-dispatched many
+  times (bench, graft entry).
+* ``cached_search_step`` (the serving path) binds a *layout-keyed* dynamic
+  program: the nonce's packed words, the absorbed prefix state, and the
+  difficulty masks are runtime operands, and the thread-byte partition is
+  two runtime scalars (``tb_lo``, ``log2 tb_count``).  The compile key is
+  only (model, tail layout, batch), where the tail layout depends on the
+  nonce length *mod block size* and the chunk width — so a worker that has
+  compiled (nonce_len=4, width=2) once serves EVERY 4-byte-nonce request at
+  ANY difficulty and ANY partition with zero recompiles.  The constant
+  words cost nothing extra at runtime: they are loop-invariant scalars XLA
+  hoists out of the batch dimension.
 """
 
 from __future__ import annotations
@@ -70,7 +82,121 @@ def build_search_step(
     return jax.jit(step) if jit else step
 
 
-@functools.lru_cache(maxsize=64)
+def eval_dyn_candidates(model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk):
+    """Hash a batch against runtime-operand nonce words.
+
+    The dynamic-regime twin of ``_eval_candidates``: the tail layout
+    (``n_blocks``, ``tb_loc``, ``chunk_locs``) is static, while the
+    absorbed prefix state ``init[S]`` and constant words
+    ``base[n_blocks,16]`` are device operands.  Shared by the
+    single-device and mesh dynamic steps.  Returns the state tuple.
+    """
+    state = tuple(init[i] for i in range(len(model.init_state)))
+    for b in range(n_blocks):
+        words = [base[b, w] for w in range(16)]
+        bb, w, s = tb_loc
+        if bb == b:
+            words[w] = words[w] | (tb << s)
+        for j, (cb, cw, cs) in enumerate(chunk_locs):
+            if cb == b:
+                byte_j = (chunk >> jnp.uint32(8 * j)) & jnp.uint32(0xFF)
+                words[cw] = words[cw] | (byte_j << cs)
+        state = model.compress(state, words)
+    return state
+
+
+def fold_dyn_masks(model, state, masks):
+    """Hit mask against runtime-operand difficulty masks."""
+    acc = state[0] & masks[0]
+    for i in range(1, model.digest_words):
+        acc = acc | (state[i] & masks[i])
+    return acc == 0
+
+
+@functools.lru_cache(maxsize=None)
+def _dyn_search_step(
+    model_name: str,
+    n_blocks: int,
+    tb_loc,
+    chunk_locs,
+    batch: int,
+    static_tbc,  # None => power-of-two partition passed as log2 operand
+):
+    """Layout-keyed jitted step with nonce/difficulty/partition as operands.
+
+    Signature of the returned jitted fn (all uint32):
+    ``(init_state[S], base_words[n_blocks,16], masks[D], tb_lo,
+    log_tbc_or_nothing, chunk0) -> uint32``.
+    """
+    model = get_hash_model(model_name)
+
+    if static_tbc is None:
+
+        def step(init, base, masks, tb_lo, log_tbc, chunk0):
+            f = jnp.arange(batch, dtype=jnp.uint32)
+            chunk = jnp.uint32(chunk0) + (f >> log_tbc)
+            tb = tb_lo + (f & ((jnp.uint32(1) << log_tbc) - jnp.uint32(1)))
+            state = eval_dyn_candidates(
+                model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
+            )
+            hit = fold_dyn_masks(model, state, masks)
+            return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
+
+    else:
+
+        def step(init, base, masks, tb_lo, chunk0):
+            f = jnp.arange(batch, dtype=jnp.uint32)
+            chunk = jnp.uint32(chunk0) + f // jnp.uint32(static_tbc)
+            tb = tb_lo + f % jnp.uint32(static_tbc)
+            state = eval_dyn_candidates(
+                model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
+            )
+            hit = fold_dyn_masks(model, state, masks)
+            return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
+
+    return jax.jit(step)
+
+
+@functools.lru_cache(maxsize=None)
+def _dyn_search_step_w0(model_name: str, n_blocks: int, tb_loc, chunk_locs):
+    """Width-0 probe program: scan ALL 256 thread bytes, mask the ones
+    outside the runtime partition.
+
+    Width 0 has exactly ``tb_count`` candidates, so keying the batch on
+    the partition would recompile per worker split — defeating warmup.
+    Instead one fixed-shape (256) program serves every partition: the
+    partition is the runtime pair (tb_lo, tbc) and out-of-partition hits
+    are masked off.  Returns the partition-local flat index (tb - tb_lo)
+    of the first hit, or SENTINEL — identical contract to the general
+    step at width 0.
+    """
+    model = get_hash_model(model_name)
+
+    def step(init, base, masks, tb_lo, tbc, chunk0):
+        del chunk0  # width 0: no chunk bytes
+        tb = jnp.arange(256, dtype=jnp.uint32)
+        state = eval_dyn_candidates(
+            model, n_blocks, tb_loc, chunk_locs, init, base, tb,
+            jnp.uint32(0),
+        )
+        hit = fold_dyn_masks(model, state, masks)
+        hit = hit & (tb >= tb_lo) & (tb < tb_lo + tbc)
+        return jnp.min(jnp.where(hit, tb - tb_lo, jnp.uint32(SENTINEL)))
+
+    return jax.jit(step)
+
+
+def step_operands(spec: TailSpec, difficulty: int, model: HashModel):
+    """Device operands binding one (nonce, difficulty) onto a dyn step."""
+    masks = nibble_masks(difficulty, model)
+    return (
+        jnp.asarray(spec.init_state, jnp.uint32),
+        jnp.asarray(spec.base_words, jnp.uint32),
+        jnp.asarray(masks, jnp.uint32),
+    )
+
+
+@functools.lru_cache(maxsize=512)
 def cached_search_step(
     nonce: bytes,
     width: int,
@@ -81,17 +207,43 @@ def cached_search_step(
     model_name: str,
     extra_const_chunk: bytes = b"",
 ):
-    """Memoized ``build_search_step`` keyed on every static parameter."""
-    return build_search_step(
-        nonce,
-        width,
-        difficulty,
-        tb_lo,
-        tb_count,
-        chunks_per_step,
-        get_hash_model(model_name),
-        extra_const_chunk,
+    """Serving-path step: binds request operands onto a layout-keyed
+    dynamic program (see module docstring).  Same contract as
+    ``build_search_step``."""
+    model = get_hash_model(model_name)
+    spec = build_tail_spec(bytes(nonce), width, model, extra_const_chunk)
+    init, base, masks = step_operands(spec, difficulty, model)
+    tb_lo_op = jnp.uint32(tb_lo)
+
+    if width == 0:
+        w0 = _dyn_search_step_w0(
+            model_name, spec.n_blocks, spec.tb_loc, spec.chunk_locs
+        )
+        tbc_op = jnp.uint32(tb_count)
+
+        def bound0(chunk0):
+            return w0(init, base, masks, tb_lo_op, tbc_op, chunk0)
+
+        return bound0
+
+    batch = chunks_per_step * tb_count
+    pow2 = tb_count & (tb_count - 1) == 0
+    dyn = _dyn_search_step(
+        model_name, spec.n_blocks, spec.tb_loc, spec.chunk_locs, batch,
+        None if pow2 else tb_count,
     )
+    if pow2:
+        log_tbc = jnp.uint32(tb_count.bit_length() - 1)
+
+        def bound(chunk0):
+            return dyn(init, base, masks, tb_lo_op, log_tbc, chunk0)
+
+    else:
+
+        def bound(chunk0):
+            return dyn(init, base, masks, tb_lo_op, chunk0)
+
+    return bound
 
 
 def flat_to_candidate(

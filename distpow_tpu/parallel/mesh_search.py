@@ -37,7 +37,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.registry import HashModel, get_hash_model
 from ..ops.difficulty import nibble_masks
 from ..ops.packing import build_tail_spec
-from ..ops.search_step import SENTINEL, _eval_candidates
+from ..ops.search_step import (
+    SENTINEL,
+    _eval_candidates,
+    cached_search_step,
+    eval_dyn_candidates,
+    fold_dyn_masks,
+    step_operands,
+)
 from .search import SearchResult, StepFactory, contiguous_bounds, search
 
 AXIS = "workers"
@@ -46,6 +53,58 @@ AXIS = "workers"
 def make_mesh(devices: Optional[Sequence] = None, axis: str = AXIS) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devs), (axis,))
+
+
+@functools.lru_cache(maxsize=None)
+def _dyn_mesh_step(
+    mesh: Mesh,
+    axis: str,
+    model_name: str,
+    n_blocks: int,
+    tb_loc,
+    chunk_locs,
+    batch_local: int,
+    tb_split: bool,
+    log_ndev: int,
+):
+    """Layout-keyed jitted mesh step (the dynamic regime of
+    ops/search_step.py, spread over the device mesh).
+
+    Returned fn: ``(init[S], base[n_blocks,16], masks[D],
+    part[2]=(tb_lo, log_tbc), chunk0) -> uint32`` — the *global* first-hit
+    flat index after the ``lax.pmin`` collective, or SENTINEL.
+    """
+    model = get_hash_model(model_name)
+    one = jnp.uint32(1)
+
+    def body(init, base, masks, part, chunk0):
+        d = jax.lax.axis_index(axis).astype(jnp.uint32)
+        tb_lo, log_tbc = part[0], part[1]
+        fl = jnp.arange(batch_local, dtype=jnp.uint32)
+        if tb_split:
+            log_tbl = log_tbc - jnp.uint32(log_ndev)
+            chunk_off = fl >> log_tbl
+            tb_local = fl & ((one << log_tbl) - one)
+            tb = tb_lo + (d << log_tbl) + tb_local
+            f_global = (chunk_off << log_tbc) + (d << log_tbl) + tb_local
+        else:
+            chunks_local = jnp.uint32(batch_local) >> log_tbc
+            chunk_off = d * chunks_local + (fl >> log_tbc)
+            tb_idx = fl & ((one << log_tbc) - one)
+            tb = tb_lo + tb_idx
+            f_global = (chunk_off << log_tbc) + tb_idx
+        chunk = jnp.uint32(chunk0) + chunk_off
+        state = eval_dyn_candidates(
+            model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
+        )
+        hit = fold_dyn_masks(model, state, masks)
+        m = jnp.min(jnp.where(hit, f_global, jnp.uint32(SENTINEL)))
+        return jax.lax.pmin(m, axis)
+
+    sharded = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P(), P(), P(), P()), out_specs=P()
+    )
+    return jax.jit(sharded)
 
 
 def _mesh_step_factory(
@@ -57,11 +116,30 @@ def _mesh_step_factory(
     mesh: Mesh,
     axis: str,
 ) -> StepFactory:
-    n_dev = mesh.devices.size
+    n_dev = int(mesh.devices.size)
     tb_split = tbc >= n_dev and tbc % n_dev == 0
+    pow2 = (tbc & (tbc - 1)) == 0 and (n_dev & (n_dev - 1)) == 0
 
     @functools.lru_cache(maxsize=32)
-    def build(vw: int, extra: bytes, chunks_local: int):
+    def bind_dyn(vw: int, extra: bytes, chunks_local: int):
+        spec = build_tail_spec(bytes(nonce), vw, model, extra)
+        tbl = tbc // n_dev if tb_split else tbc
+        dyn = _dyn_mesh_step(
+            mesh, axis, model.name, spec.n_blocks, spec.tb_loc,
+            spec.chunk_locs, chunks_local * tbl, tb_split,
+            n_dev.bit_length() - 1,
+        )
+        init, base, masks = step_operands(spec, difficulty, model)
+        part = jnp.asarray([tb_lo, tbc.bit_length() - 1], jnp.uint32)
+
+        def step(chunk0):
+            return dyn(init, base, masks, part, chunk0)
+
+        return step
+
+    @functools.lru_cache(maxsize=32)
+    def build_static(vw: int, extra: bytes, chunks_local: int):
+        """Fallback for non-power-of-two partitions or device counts."""
         spec = build_tail_spec(bytes(nonce), vw, model, extra)
         masks = nibble_masks(difficulty, model)
 
@@ -102,18 +180,26 @@ def _mesh_step_factory(
         sharded = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P())
         return jax.jit(sharded)
 
+    build = bind_dyn if pow2 else build_static
+
     def factory(vw: int, extra: bytes, target_chunks: int):
         if vw == 0:
-            chunks_local = 1
-        elif tb_split:
+            # 256 candidates max — no mesh benefit; reuse the shared
+            # layout-keyed width-0 probe (single device, warmup-covered)
+            return (
+                cached_search_step(
+                    bytes(nonce), 0, difficulty, tb_lo, tbc, 1,
+                    model.name, bytes(extra),
+                ),
+                1,
+            )
+        if tb_split:
             # every device scans the same chunks on its own tb slice
             chunks_local = max(1, target_chunks)
         else:
             chunks_local = max(1, target_chunks // n_dev)
         step = build(vw, bytes(extra), chunks_local)
         global_chunks = chunks_local if tb_split else chunks_local * n_dev
-        if vw == 0:
-            global_chunks = 1
         return step, global_chunks
 
     return factory

@@ -42,6 +42,18 @@ from ..ops.search_step import SENTINEL, cached_search_step
 DEFAULT_BATCH = 1 << 20
 DEFAULT_PIPELINE_DEPTH = 2
 
+
+def effective_batch(batch_size: int) -> int:
+    """Requested batch size normalized to a partition-independent value.
+
+    The serving batch must be a pure function of the configured size —
+    NOT of the request's thread-byte count — so that the layout-keyed
+    programs warmed at boot (tbc=256) are byte-for-byte the programs
+    every power-of-two partition dispatches.  Rounding down to a
+    multiple of 256 makes ``chunks * tbc == effective_batch`` exact for
+    every pow2 tbc <= 256."""
+    return max(256, batch_size - batch_size % 256)
+
 # A step factory maps (variable_width, extra_const_chunk, target_chunks) to
 # (step_fn, chunks_per_step) where step_fn(chunk0)->uint32 evaluates
 # chunks_per_step * tb_count candidates starting at chunk0 and returns the
@@ -146,7 +158,7 @@ def search(
     factory = step_factory or default_step_factory(
         nonce, difficulty, tb_lo, tbc, model
     )
-    target_chunks = max(1, batch_size // tbc)
+    target_chunks = max(1, effective_batch(batch_size) // tbc)
 
     hashes = 0
     # FIFO of in-flight launches: (result, chunk0, var_width, extra, n_cand)

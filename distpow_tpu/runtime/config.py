@@ -72,6 +72,14 @@ class WorkerConfig:
     HashModel: str = "md5"
     BatchSize: int = 1 << 20
     MeshDevices: int = 0  # 0 = all local devices (jax-mesh backend)
+    # Pre-compile the layout-keyed search programs for these nonce byte
+    # lengths at boot (background thread), so the first Mine RPC is pure
+    # dispatch.  The compiled programs are nonce-content-, difficulty- and
+    # partition-independent (ops/search_step.py dynamic regime); only the
+    # nonce *length* and chunk width key the compile.  Empty list = no
+    # warmup.
+    WarmupNonceLens: List[int] = field(default_factory=lambda: [2, 4])
+    WarmupWidths: List[int] = field(default_factory=lambda: [0, 1, 2, 3])
 
 
 @dataclass

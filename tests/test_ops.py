@@ -122,3 +122,84 @@ def test_search_step_sha256():
         chunk, tb = flat_to_candidate(f, 1, 0, 256)
         found = bytes([tb]) + puzzle.int_to_chunk(chunk)
     assert found == oracle
+
+
+# ---------------------------------------------------------------------------
+# Dynamic (serving-path) regime: cached_search_step binds nonce/difficulty/
+# partition as runtime operands onto layout-keyed compiled programs.
+# ---------------------------------------------------------------------------
+
+from distpow_tpu.ops.search_step import _dyn_search_step, cached_search_step
+
+
+@pytest.mark.parametrize("model", [MD5, SHA256])
+@pytest.mark.parametrize("nonce_len,width", [(2, 1), (4, 2), (63, 1), (70, 2)])
+def test_dyn_step_matches_static(model, nonce_len, width):
+    rng = random.Random(nonce_len * 31 + width)
+    nonce = bytes(rng.randrange(256) for _ in range(nonce_len))
+    for difficulty, tb_lo, tbc in ((1, 0, 256), (2, 64, 64)):
+        dyn = cached_search_step(
+            nonce, width, difficulty, tb_lo, tbc, 8, model.name
+        )
+        static = build_search_step(
+            nonce, width, difficulty, tb_lo, tbc, 8, model
+        )
+        for c0 in (1, 77, 255):
+            assert int(dyn(jnp.uint32(c0))) == int(static(jnp.uint32(c0)))
+
+
+def test_dyn_step_compile_reuse_across_requests():
+    """Different nonces, difficulties, and power-of-two partitions of the
+    same (length, width, batch) must share one compiled program."""
+    _dyn_search_step.cache_clear()
+    cached_search_step.cache_clear()
+    cached_search_step(b"\x01\x02\x03\x04", 2, 3, 0, 256, 16, "md5")
+    before = _dyn_search_step.cache_info()
+    # same length/width/batch, different content/difficulty/partition:
+    cached_search_step(b"\xaa\xbb\xcc\xdd", 2, 7, 0, 256, 16, "md5")
+    cached_search_step(b"\x01\x02\x03\x04", 2, 5, 64, 64, 64, "md5")  # batch 4096 == 16*256
+    after = _dyn_search_step.cache_info()
+    assert after.misses == before.misses, "unexpected recompile"
+    assert after.hits > before.hits
+    # different length => new layout => one new compile
+    cached_search_step(b"\x01\x02\x03", 2, 3, 0, 256, 16, "md5")
+    assert _dyn_search_step.cache_info().misses == before.misses + 1
+
+
+def test_dyn_step_non_pow2_partition_falls_back():
+    nonce = b"\x0e\x0f"
+    dyn = cached_search_step(nonce, 1, 1, 10, 96, 4, "md5")
+    static = build_search_step(nonce, 1, 1, 10, 96, 4, MD5)
+    for c0 in (1, 100):
+        assert int(dyn(jnp.uint32(c0))) == int(static(jnp.uint32(c0)))
+
+
+def test_backend_warmup_smoke():
+    from distpow_tpu.backends import JaxBackend
+
+    b = JaxBackend(batch_size=1 << 12)
+    b.warmup([3], [0, 1])
+    # warmed layouts serve a real request without new dyn compiles
+    before = _dyn_search_step.cache_info().misses
+    secret = b.search(b"\x09\x08\x07", 2, list(range(256)))
+    assert secret is not None
+    assert puzzle.check_secret(b"\x09\x08\x07", secret, 2)
+    assert _dyn_search_step.cache_info().misses == before
+
+
+def test_w0_program_partition_independent():
+    """Width-0 probes share one layout-keyed program across partitions
+    (the first Mine on any worker split is pure dispatch after warmup)."""
+    from distpow_tpu.ops.search_step import _dyn_search_step_w0
+
+    _dyn_search_step_w0.cache_clear()
+    cached_search_step.cache_clear()
+    nonce = b"\x0c\x0d"
+    full = cached_search_step(nonce, 0, 1, 0, 256, 1, "md5")
+    misses = _dyn_search_step_w0.cache_info().misses
+    quarter = cached_search_step(nonce, 0, 1, 64, 64, 1, "md5")
+    assert _dyn_search_step_w0.cache_info().misses == misses
+    # results agree with the static program on both partitions
+    for dyn, (lo, cnt) in ((full, (0, 256)), (quarter, (64, 64))):
+        static = build_search_step(nonce, 0, 1, lo, cnt, 1, MD5)
+        assert int(dyn(jnp.uint32(0))) == int(static(jnp.uint32(0)))
