@@ -56,6 +56,23 @@ def _warm_factory(factory, widths, target_chunks) -> None:
         int(step(1))  # block_until_ready via the int() conversion
 
 
+def _warm_layouts(build, nonce_lens, widths, batch_size, tbc=256) -> None:
+    """Warm the layout-keyed programs for every (nonce length, width).
+
+    ``build(nonce, tbc) -> StepFactory`` builds the factory for the full
+    partition ``[0, tbc)``.  ``target_chunks`` is derived from
+    ``effective_batch`` with the same ``tbc`` the factory was built for —
+    the serving path computes the identical value (parallel/search.py),
+    which is what makes the warmed compile keys byte-identical to the
+    ones serving dispatches.
+    """
+    from ..parallel.search import effective_batch
+
+    target = max(1, effective_batch(batch_size) // tbc)
+    for L in nonce_lens:
+        _warm_factory(build(bytes(int(L)), tbc), widths, target)
+
+
 class JaxBackend:
     """Single-device fused-step search (the TPU path)."""
 
@@ -73,12 +90,12 @@ class JaxBackend:
         length and the full 256-byte partition covers every future nonce
         of that length at any difficulty and any power-of-two partition.
         """
-        from ..parallel.search import default_step_factory, effective_batch
+        from ..parallel.search import default_step_factory
 
-        for L in nonce_lens:
-            factory = default_step_factory(bytes(int(L)), 1, 0, 256, self.model)
-            _warm_factory(factory, widths,
-                          max(1, effective_batch(self.batch_size) // 256))
+        _warm_layouts(
+            lambda nonce, tbc: default_step_factory(nonce, 1, 0, tbc, self.model),
+            nonce_lens, widths, self.batch_size,
+        )
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
         from ..parallel.search import search
@@ -125,9 +142,9 @@ class JaxMeshBackend:
 
     def warmup(self, nonce_lens: Sequence[int], widths: Sequence[int]) -> None:
         from ..parallel.mesh_search import AXIS, _mesh_step_factory
-        from ..parallel.search import effective_batch
 
-        n_dev = int(self._get_mesh().devices.size)
+        mesh = self._get_mesh()
+        n_dev = int(mesh.devices.size)
         if n_dev & (n_dev - 1):
             # non-power-of-two mesh: the factory compiles nonce-content-
             # keyed static programs that cannot be reused by later
@@ -135,12 +152,19 @@ class JaxMeshBackend:
             log.info("mesh warmup skipped: %d devices (not a power of two)",
                      n_dev)
             return
-        for L in nonce_lens:
-            factory = _mesh_step_factory(
-                bytes(int(L)), 1, 0, 256, self.model, self._get_mesh(), AXIS
-            )
-            _warm_factory(factory, widths,
-                          max(1, effective_batch(self.batch_size) // 256))
+
+        def build(nonce, tbc):
+            return _mesh_step_factory(nonce, 1, 0, tbc, self.model, mesh, AXIS)
+
+        _warm_layouts(build, nonce_lens, widths, self.batch_size)
+        if n_dev > 1:
+            # a partition smaller than the device count selects the
+            # chunk-split regime (tb_split=False), a distinct compile key;
+            # one representative tbc < n_dev warms it for every pow2
+            # partition because batch_local is the 256-normalized
+            # per-device budget in all of them (mesh_search.py factory)
+            _warm_layouts(build, nonce_lens, widths, self.batch_size,
+                          tbc=n_dev // 2)
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
         from ..parallel.mesh_search import search_mesh

@@ -68,13 +68,12 @@ class PallasBackend:
         return factory
 
     def warmup(self, nonce_lens, widths) -> None:
-        from . import _warm_factory
-        from ..parallel.search import effective_batch
+        from . import _warm_layouts
 
-        for L in nonce_lens:
-            factory = self._factory(bytes(int(L)), 1, 0, 256)
-            _warm_factory(factory, widths,
-                          max(1, effective_batch(self.batch_size) // 256))
+        _warm_layouts(
+            lambda nonce, tbc: self._factory(nonce, 1, 0, tbc),
+            nonce_lens, widths, self.batch_size,
+        )
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None) -> Optional[bytes]:
         nonce = bytes(nonce)

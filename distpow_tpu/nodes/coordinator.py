@@ -279,6 +279,25 @@ class Coordinator:
         self.client_addr: Optional[str] = None
         self.worker_addr: Optional[str] = None
 
+    def set_worker_addrs(self, addrs: List[str]) -> None:
+        """Rebind worker addresses after construction.
+
+        The reference fixes the worker list in static config
+        (config/coordinator_config.json:4-9) and dials lazily with retry
+        (coordinator.go:169-172, 356-368).  We keep the lazy dial but also
+        support ':0'-bound workers whose real ports are only known after
+        they listen; call this before the first Mine.
+        """
+        if len(addrs) != len(self.handler.workers):
+            raise ValueError(
+                f"expected {len(self.handler.workers)} worker addrs, "
+                f"got {len(addrs)}"
+            )
+        for ref, addr in zip(self.handler.workers, addrs):
+            if ref.client is not None and ref.addr != addr:
+                raise RuntimeError(f"worker {ref.worker_byte} already dialed")
+            ref.addr = addr
+
     def initialize_rpcs(self) -> Tuple[str, str]:
         """Bind the segregated worker-facing and client-facing listeners."""
         self.worker_addr = self.server.listen(self.config.WorkerAPIListenAddr)

@@ -197,7 +197,14 @@ def _mesh_step_factory(
             # every device scans the same chunks on its own tb slice
             chunks_local = max(1, target_chunks)
         else:
-            chunks_local = max(1, target_chunks // n_dev)
+            # chunk split: normalize the per-device budget to a multiple
+            # of 256 so batch_local — the compile key — is independent of
+            # which pow2 tbc < n_dev the request carries; one warmed
+            # program then serves every small partition (target_chunks *
+            # tbc recovers effective_batch exactly: tbc | effective_batch
+            # because both are pow2-multiples of <=256)
+            eb_local = max(256, (target_chunks * tbc // n_dev) // 256 * 256)
+            chunks_local = max(1, eb_local // tbc)
         step = build(vw, bytes(extra), chunks_local)
         global_chunks = chunks_local if tb_split else chunks_local * n_dev
         return step, global_chunks

@@ -8,7 +8,6 @@ then clients; SURVEY.md section 3.5).
 """
 
 import queue
-import socket
 import threading
 import time
 
@@ -20,52 +19,46 @@ from distpow_tpu.runtime.config import ClientConfig, CoordinatorConfig, WorkerCo
 from distpow_tpu.runtime.tracing import MemorySink
 
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 class Stack:
-    """coordinator + N workers + client(s), each with a MemorySink."""
+    """coordinator + N workers + client(s), each with a MemorySink.
+
+    Everything binds on ':0' and real addresses are wired afterwards
+    (Coordinator.set_worker_addrs) — no probe-then-rebind port races.
+    """
 
     def __init__(self, n_workers: int, backend: str = "python", difficulty_model="md5"):
-        coord_client_port = free_port()
-        coord_worker_port = free_port()
-        worker_ports = [free_port() for _ in range(n_workers)]
-
         self.sinks = {"coordinator": MemorySink()}
         self.coordinator = Coordinator(
             CoordinatorConfig(
-                ClientAPIListenAddr=f"127.0.0.1:{coord_client_port}",
-                WorkerAPIListenAddr=f"127.0.0.1:{coord_worker_port}",
-                Workers=[f"127.0.0.1:{p}" for p in worker_ports],
+                ClientAPIListenAddr="127.0.0.1:0",
+                WorkerAPIListenAddr="127.0.0.1:0",
+                Workers=["pending:0"] * n_workers,
             ),
             sink=self.sinks["coordinator"],
         )
-        self.coordinator.initialize_rpcs()
+        client_addr, worker_api_addr = self.coordinator.initialize_rpcs()
 
         self.workers = []
-        for i, p in enumerate(worker_ports):
+        worker_addrs = []
+        for i in range(n_workers):
             wid = f"worker{i + 1}"
             self.sinks[wid] = MemorySink()
             w = Worker(
                 WorkerConfig(
                     WorkerID=wid,
-                    ListenAddr=f"127.0.0.1:{p}",
-                    CoordAddr=f"127.0.0.1:{coord_worker_port}",
+                    ListenAddr="127.0.0.1:0",
+                    CoordAddr=worker_api_addr,
                     Backend=backend,
                     HashModel=difficulty_model,
                 ),
                 sink=self.sinks[wid],
             )
-            w.initialize_rpcs()
+            worker_addrs.append(w.initialize_rpcs())
             w.start_forwarder()
             self.workers.append(w)
+        self.coordinator.set_worker_addrs(worker_addrs)
 
-        self.coord_client_addr = f"127.0.0.1:{coord_client_port}"
+        self.coord_client_addr = client_addr
         self.clients = []
 
     def new_client(self, cid: str) -> Client:

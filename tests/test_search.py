@@ -124,6 +124,29 @@ def test_mesh_search_sub_partition_and_chunk_split():
     assert got is not None and got.secret == oracle
 
 
+def test_mesh_warmup_covers_all_pow2_partitions():
+    """Boot warmup must pre-compile both mesh regimes, and batch_local
+    must be partition-independent even when the configured batch size is
+    not divisible by tbc * n_dev (e.g. 10_000 on 8 devices), so every
+    pow2 partition's first Mine is pure dispatch."""
+    from distpow_tpu.backends import JaxMeshBackend
+    from distpow_tpu.parallel.mesh_search import _dyn_mesh_step
+
+    b = JaxMeshBackend(batch_size=10_000)
+    b.warmup([4], [0, 1])
+    misses = _dyn_mesh_step.cache_info().misses
+    n_dev = int(b._get_mesh().devices.size)
+    for tbs in (list(range(256)),               # tb-split
+                list(range(max(1, n_dev // 2))),  # chunk-split, warmed tbc
+                [7],                             # chunk-split, other tbc
+                list(range(4, 6))):
+        secret = b.search(b"\x00\x01\x02\x03", 2, tbs)
+        assert secret is not None
+        assert puzzle.check_secret(b"\x00\x01\x02\x03", secret, 2)
+    assert _dyn_mesh_step.cache_info().misses == misses, \
+        "serving recompiled a program warmup should have covered"
+
+
 def test_mesh_search_cancellation():
     mesh = make_mesh(jax.devices())
     got = search_mesh(
