@@ -3,9 +3,12 @@
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "MH/s", "vs_baseline": N}
 
-* ``value``: best sustained device throughput (MH/s/chip) of the fused
-  search step across the XLA and Pallas paths at difficulty 8 nibbles
-  (32 bits, BASELINE.md config 4's difficulty) on width-4 chunks.
+* ``value``: sustained device throughput (MH/s/chip) of the SERVING path —
+  the layout-keyed dynamic search step exactly as a booted worker
+  dispatches it (ops/search_step.py cached regime, launch multiplier
+  included) at difficulty 8 nibbles (32 bits, BASELINE.md config 4's
+  difficulty) on width-4 chunks.  Static-compiled and Pallas rates go to
+  stderr for comparison.
 * ``vs_baseline``: ratio against a single CPU worker-equivalent — the
   native C++ miner at one thread (a strictly-faster stand-in for the
   reference's single-goroutine Go worker, BASELINE.md config 1; the Go
@@ -27,26 +30,31 @@ def device_rate(step_builder, label: str, min_seconds: float = 2.0) -> float:
 
     Adaptively scales the launch count until the timed window is at least
     ``min_seconds`` so remote-tunnel dispatch jitter can't dominate.
+
+    Synchronization: the timed window ends with ``int(last_out)`` — a
+    device_get of the final launch's result.  Launches execute FIFO, so
+    fetching the last value proves every prior launch completed.  (Do NOT
+    use ``block_until_ready`` here: over a remote-tunnel backend it can
+    return before queued work actually ran, inflating rates by orders of
+    magnitude and leaving minutes of queued device work behind.)
     """
     import jax.numpy as jnp
 
     step, batch = step_builder()
-    # warmup / compile
-    step(jnp.uint32(1 << 24)).block_until_ready()
+    int(step(jnp.uint32(1 << 24)))  # compile + real sync
 
-    iters = 8
+    iters = 4
     while True:
         t0 = time.time()
-        outs = [
-            step(jnp.uint32(((1 << 24) + i * batch) & 0xFFFFFFFF))
-            for i in range(iters)
-        ]
-        for o in outs:
-            o.block_until_ready()
+        out = None
+        for i in range(iters):
+            out = step(jnp.uint32(((1 << 24) + i * batch) & 0xFFFFFFFF))
+        sink = int(out)  # forces the whole FIFO of launches to complete
         dt = time.time() - t0
-        if dt >= min_seconds or iters >= 1 << 14:
+        if dt >= min_seconds or iters >= 1 << 10:
             break
-        iters = min(1 << 14, max(iters * 2, int(iters * min_seconds / max(dt, 1e-4)) + 1))
+        iters = min(1 << 10, max(iters * 2, int(iters * min_seconds / max(dt, 1e-3)) + 1))
+    del sink
     rate = batch * iters / dt
     print(f"[bench] {label}: {rate / 1e6:.2f} MH/s "
           f"({iters} x {batch} candidates in {dt:.3f}s)", file=sys.stderr)
@@ -61,27 +69,36 @@ def main() -> None:
     from distpow_tpu.models.registry import get_hash_model
     from distpow_tpu.ops.search_step import build_search_step, cached_search_step
 
+    from distpow_tpu.parallel.search import launch_steps_for
+
     model = get_hash_model("md5")
     nonce = b"\x01\x02\x03\x04"
     difficulty = 8
-    chunks = 8192  # x 256 thread bytes = 2^21 candidates per launch
+    chunks = 8192  # x 256 thread bytes = 2^21 candidates per sub-batch
+    # the launch multiplier a serving worker would use for width-4 chunks
+    k = launch_steps_for(4, chunks, 256)
 
-    def xla_builder():
-        step = build_search_step(
-            nonce, 4, difficulty, 0, 256, chunks, model
-        )
-        return step, chunks * 256
-
-    def xla_dyn_builder():
-        # the serving path: nonce/difficulty/partition are runtime operands
+    def serving_builder():
+        # the serving path: nonce/difficulty/partition are runtime
+        # operands; k sub-batches per dispatch amortize the round trip
         step = cached_search_step(
-            nonce, 4, difficulty, 0, 256, chunks, model.name
+            nonce, 4, difficulty, 0, 256, chunks, model.name, b"", k
         )
-        return step, chunks * 256
+        return step, chunks * 256 * k
+
+    def xla_static_builder():
+        step = build_search_step(
+            nonce, 4, difficulty, 0, 256, chunks, model, launch_steps=k
+        )
+        return step, chunks * 256 * k
 
     rates = {
-        "xla": device_rate(xla_builder, "xla fused step"),
-        "xla-dyn": device_rate(xla_dyn_builder, "xla dynamic (serving) step"),
+        "serving": device_rate(
+            serving_builder, f"serving (dynamic) step, k={k}"
+        ),
+        "xla-static": device_rate(
+            xla_static_builder, f"static-compiled step, k={k}"
+        ),
     }
 
     try:
@@ -93,12 +110,12 @@ def main() -> None:
             )
             return step, chunks * 256
 
-        rates["pallas"] = device_rate(pallas_builder, "pallas kernel")
+        rates["pallas"] = device_rate(pallas_builder, "pallas kernel (k=1)")
     except Exception as exc:  # pallas unsupported on this backend
         print(f"[bench] pallas path unavailable: {exc}", file=sys.stderr)
 
-    best_label = max(rates, key=rates.get)
-    best = rates[best_label]
+    best_label = "serving"
+    best = rates["serving"]
 
     # end-to-end wall-clock to first valid nonce (BASELINE.md's second
     # metric): warm the layout-keyed programs the way a booted worker does
@@ -111,16 +128,16 @@ def main() -> None:
 
         backend = JaxBackend(batch_size=1 << 21)
         t0 = time.time()
-        backend.warmup([4], [0, 1, 2, 3])
-        print(f"[bench] worker warmup (len-4 nonces, widths 0-3): "
+        backend.warmup([4], [0, 1, 2, 3, 4])
+        print(f"[bench] worker warmup (len-4 nonces, widths 0-4): "
               f"{time.time() - t0:.1f}s one-time", file=sys.stderr)
-        for nonce_e2e in (b"\x13\x57\x9b\xdf", b"\x24\x68\xac\xe0"):
+        for nonce_e2e, d in ((b"\x13\x57\x9b\xdf", 8), (b"\x24\x68\xac\xe0", 8)):
             t0 = time.time()
-            secret = backend.search(nonce_e2e, 6, list(range(256)))
+            secret = backend.search(nonce_e2e, d, list(range(256)))
             dt = time.time() - t0
             assert secret is not None
-            assert puzzle.check_secret(nonce_e2e, secret, 6)
-            print(f"[bench] e2e diff=24bit solve of {nonce_e2e.hex()}: "
+            assert puzzle.check_secret(nonce_e2e, secret, d)
+            print(f"[bench] e2e diff={4 * d}bit solve of {nonce_e2e.hex()}: "
                   f"secret={secret.hex()} in {dt:.2f}s wall-clock",
                   file=sys.stderr)
     except Exception as exc:
@@ -161,7 +178,7 @@ def main() -> None:
               file=sys.stderr)
 
     print(json.dumps({
-        "metric": f"MH/s/chip md5 pow search ({best_label} step, diff=32bits)",
+        "metric": f"MH/s/chip md5 pow search ({best_label} path, diff=32bits)",
         "value": round(best / 1e6, 3),
         "unit": "MH/s",
         "vs_baseline": round(best / baseline, 2),

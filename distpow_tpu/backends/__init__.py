@@ -49,28 +49,35 @@ class PythonBackend:
         )
 
 
-def _warm_factory(factory, widths, target_chunks) -> None:
+def _warm_factory(factory, widths, target_chunks, tbc, max_launch) -> None:
     """Compile-and-dispatch each width's step once (tiny real launch)."""
+    from ..parallel.search import launch_steps_for
+
     for vw in widths:
-        step, _ = factory(int(vw), b"", target_chunks)
+        k = launch_steps_for(int(vw), target_chunks, tbc, max_launch)
+        step, _ = factory(int(vw), b"", target_chunks, k)
         int(step(1))  # block_until_ready via the int() conversion
 
 
-def _warm_layouts(build, nonce_lens, widths, batch_size, tbc=256) -> None:
+def _warm_layouts(build, nonce_lens, widths, batch_size, tbc=256,
+                  max_launch=None) -> None:
     """Warm the layout-keyed programs for every (nonce length, width).
 
     ``build(nonce, tbc) -> StepFactory`` builds the factory for the full
-    partition ``[0, tbc)``.  ``target_chunks`` is derived from
-    ``effective_batch`` with the same ``tbc`` the factory was built for —
-    the serving path computes the identical value (parallel/search.py),
-    which is what makes the warmed compile keys byte-identical to the
-    ones serving dispatches.
+    partition ``[0, tbc)``.  ``target_chunks`` and the per-width launch
+    multiplier are derived exactly the way the serving path derives them
+    (parallel/search.py: ``effective_batch`` with the same ``tbc``,
+    ``launch_steps_for`` with the same budget) — which is what makes the
+    warmed compile keys byte-identical to the ones serving dispatches.
     """
-    from ..parallel.search import effective_batch
+    from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES, effective_batch
 
+    if max_launch is None:
+        max_launch = DEFAULT_LAUNCH_CANDIDATES
     target = max(1, effective_batch(batch_size) // tbc)
     for L in nonce_lens:
-        _warm_factory(build(bytes(int(L)), tbc), widths, target)
+        _warm_factory(build(bytes(int(L)), tbc), widths, target, tbc,
+                      max_launch)
 
 
 class JaxBackend:
@@ -78,9 +85,13 @@ class JaxBackend:
 
     name = "jax"
 
-    def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20, **_):
+    def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20,
+                 max_launch: Optional[int] = None, **_):
+        from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES
+
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
+        self.max_launch = max_launch or DEFAULT_LAUNCH_CANDIDATES
 
     def warmup(self, nonce_lens: Sequence[int], widths: Sequence[int]) -> None:
         """Pre-compile the layout-keyed programs these nonce lengths hit.
@@ -94,7 +105,7 @@ class JaxBackend:
 
         _warm_layouts(
             lambda nonce, tbc: default_step_factory(nonce, 1, 0, tbc, self.model),
-            nonce_lens, widths, self.batch_size,
+            nonce_lens, widths, self.batch_size, max_launch=self.max_launch,
         )
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
@@ -107,6 +118,7 @@ class JaxBackend:
             model=self.model,
             batch_size=self.batch_size,
             cancel_check=cancel_check,
+            launch_candidates=self.max_launch,
         )
         return None if res is None else res.secret
 
@@ -121,11 +133,15 @@ class JaxMeshBackend:
         hash_model: str = "md5",
         batch_size: int = 1 << 20,
         mesh_devices: int = 0,
+        max_launch: Optional[int] = None,
         **_,
     ):
+        from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES
+
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
         self.mesh_devices = mesh_devices
+        self.max_launch = max_launch or DEFAULT_LAUNCH_CANDIDATES
         self._mesh = None
 
     def _get_mesh(self):
@@ -156,7 +172,8 @@ class JaxMeshBackend:
         def build(nonce, tbc):
             return _mesh_step_factory(nonce, 1, 0, tbc, self.model, mesh, AXIS)
 
-        _warm_layouts(build, nonce_lens, widths, self.batch_size)
+        _warm_layouts(build, nonce_lens, widths, self.batch_size,
+                      max_launch=self.max_launch)
         if n_dev > 1:
             # a partition smaller than the device count selects the
             # chunk-split regime (tb_split=False), a distinct compile key;
@@ -164,7 +181,7 @@ class JaxMeshBackend:
             # partition because batch_local is the 256-normalized
             # per-device budget in all of them (mesh_search.py factory)
             _warm_layouts(build, nonce_lens, widths, self.batch_size,
-                          tbc=n_dev // 2)
+                          tbc=n_dev // 2, max_launch=self.max_launch)
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
         from ..parallel.mesh_search import search_mesh
@@ -177,6 +194,7 @@ class JaxMeshBackend:
             model=self.model,
             batch_size=self.batch_size,
             cancel_check=cancel_check,
+            launch_candidates=self.max_launch,
         )
         return None if res is None else res.secret
 

@@ -26,17 +26,21 @@ class PallasBackend:
         batch_size: int = 1 << 20,
         sublanes: int = 256,
         interpret: bool = False,
+        max_launch: Optional[int] = None,
         **_,
     ):
+        from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES
+
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
         self.sublanes = sublanes
         self.interpret = interpret
+        self.max_launch = max_launch or DEFAULT_LAUNCH_CANDIDATES
 
     def _factory(self, nonce: bytes, difficulty: int, tb_lo: int, tbc: int):
         tile = self.sublanes * LANES
 
-        def factory(vw: int, extra: bytes, target_chunks: int):
+        def factory(vw: int, extra: bytes, target_chunks: int, launch_steps: int = 1):
             if vw == 0:
                 # tiny width-0 probe: XLA step is fine
                 return (
@@ -46,6 +50,17 @@ class PallasBackend:
                     ),
                     1,
                 )
+            if launch_steps > 1:
+                # multi-sub-batch launches amortize the per-dispatch
+                # round trip via an on-device fori_loop the Pallas grid
+                # doesn't express; the fused XLA step (measured at parity
+                # with the kernel per-candidate) serves those
+                chunks = max(1, target_chunks)
+                step = cached_search_step(
+                    nonce, vw, difficulty, tb_lo, tbc, chunks,
+                    self.model.name, extra, launch_steps,
+                )
+                return step, chunks * launch_steps
             chunks = max(1, target_chunks)
             batch = chunks * tbc
             # round the batch up to a whole tile grid
@@ -72,7 +87,7 @@ class PallasBackend:
 
         _warm_layouts(
             lambda nonce, tbc: self._factory(nonce, 1, 0, tbc),
-            nonce_lens, widths, self.batch_size,
+            nonce_lens, widths, self.batch_size, max_launch=self.max_launch,
         )
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None) -> Optional[bytes]:
@@ -86,5 +101,6 @@ class PallasBackend:
             batch_size=self.batch_size,
             cancel_check=cancel_check,
             step_factory=self._factory(nonce, difficulty, tb_lo, tbc),
+            launch_candidates=self.max_launch,
         )
         return None if res is None else res.secret

@@ -62,24 +62,51 @@ def build_search_step(
     model: HashModel,
     extra_const_chunk: bytes = b"",
     jit: bool = True,
+    launch_steps: int = 1,
 ) -> Callable:
     """Build ``step(chunk0: uint32) -> uint32`` for one chunk width.
 
     The thread bytes scanned are ``tb_lo .. tb_lo + tb_count - 1`` (the
     partition algebra always yields contiguous runs; parallel/partition.py).
+
+    One dispatch evaluates ``launch_steps`` consecutive sub-batches of
+    ``chunks_per_step × tb_count`` candidates inside a ``fori_loop`` —
+    only one sub-batch is ever materialized, so huge launches amortize the
+    per-dispatch host<->device round trip without huge buffers.
     """
     spec = build_tail_spec(nonce, width, model, extra_const_chunk)
     masks = nibble_masks(difficulty, model)
     batch = chunks_per_step * tb_count
+    _check_launch(batch, launch_steps)
 
-    def step(chunk0):
-        f = jnp.arange(batch, dtype=jnp.uint32)
+    def sub(chunk0, f):
         chunk = jnp.uint32(chunk0) + f // jnp.uint32(tb_count)
         tb = jnp.uint32(tb_lo) + f % jnp.uint32(tb_count)
         hit = _eval_candidates(spec, masks, model, tb, chunk)
         return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
 
+    def step(chunk0):
+        f0 = jnp.arange(batch, dtype=jnp.uint32)
+        if launch_steps == 1:
+            return sub(chunk0, f0)
+
+        def body(i, best):
+            f = i.astype(jnp.uint32) * jnp.uint32(batch) + f0
+            return jnp.minimum(best, sub(chunk0, f))
+
+        return jax.lax.fori_loop(0, launch_steps, body, jnp.uint32(SENTINEL))
+
     return jax.jit(step) if jit else step
+
+
+def _check_launch(batch: int, launch_steps: int) -> None:
+    if launch_steps < 1:
+        raise ValueError(f"launch_steps must be >= 1, got {launch_steps}")
+    if batch * launch_steps > 1 << 31:
+        raise ValueError(
+            f"launch covers {batch * launch_steps} candidates; flat uint32 "
+            f"indices require <= 2^31 per dispatch"
+        )
 
 
 def eval_dyn_candidates(model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk):
@@ -121,19 +148,24 @@ def _dyn_search_step(
     chunk_locs,
     batch: int,
     static_tbc,  # None => power-of-two partition passed as log2 operand
+    launch_steps: int = 1,
 ):
     """Layout-keyed jitted step with nonce/difficulty/partition as operands.
 
     Signature of the returned jitted fn (all uint32):
     ``(init_state[S], base_words[n_blocks,16], masks[D], tb_lo,
     log_tbc_or_nothing, chunk0) -> uint32``.
+
+    ``launch_steps`` sub-batches of ``batch`` candidates run inside one
+    dispatch via ``fori_loop`` (see ``build_search_step``); the returned
+    index spans the full ``launch_steps * batch`` range.
     """
     model = get_hash_model(model_name)
+    _check_launch(batch, launch_steps)
 
     if static_tbc is None:
 
-        def step(init, base, masks, tb_lo, log_tbc, chunk0):
-            f = jnp.arange(batch, dtype=jnp.uint32)
+        def sub(tb_lo, log_tbc, init, base, masks, chunk0, f):
             chunk = jnp.uint32(chunk0) + (f >> log_tbc)
             tb = tb_lo + (f & ((jnp.uint32(1) << log_tbc) - jnp.uint32(1)))
             state = eval_dyn_candidates(
@@ -142,10 +174,24 @@ def _dyn_search_step(
             hit = fold_dyn_masks(model, state, masks)
             return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
 
+        def step(init, base, masks, tb_lo, log_tbc, chunk0):
+            f0 = jnp.arange(batch, dtype=jnp.uint32)
+            if launch_steps == 1:
+                return sub(tb_lo, log_tbc, init, base, masks, chunk0, f0)
+
+            def body(i, best):
+                f = i.astype(jnp.uint32) * jnp.uint32(batch) + f0
+                return jnp.minimum(
+                    best, sub(tb_lo, log_tbc, init, base, masks, chunk0, f)
+                )
+
+            return jax.lax.fori_loop(
+                0, launch_steps, body, jnp.uint32(SENTINEL)
+            )
+
     else:
 
-        def step(init, base, masks, tb_lo, chunk0):
-            f = jnp.arange(batch, dtype=jnp.uint32)
+        def sub(tb_lo, init, base, masks, chunk0, f):
             chunk = jnp.uint32(chunk0) + f // jnp.uint32(static_tbc)
             tb = tb_lo + f % jnp.uint32(static_tbc)
             state = eval_dyn_candidates(
@@ -153,6 +199,19 @@ def _dyn_search_step(
             )
             hit = fold_dyn_masks(model, state, masks)
             return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
+
+        def step(init, base, masks, tb_lo, chunk0):
+            f0 = jnp.arange(batch, dtype=jnp.uint32)
+            if launch_steps == 1:
+                return sub(tb_lo, init, base, masks, chunk0, f0)
+
+            def body(i, best):
+                f = i.astype(jnp.uint32) * jnp.uint32(batch) + f0
+                return jnp.minimum(best, sub(tb_lo, init, base, masks, chunk0, f))
+
+            return jax.lax.fori_loop(
+                0, launch_steps, body, jnp.uint32(SENTINEL)
+            )
 
     return jax.jit(step)
 
@@ -206,10 +265,12 @@ def cached_search_step(
     chunks_per_step: int,
     model_name: str,
     extra_const_chunk: bytes = b"",
+    launch_steps: int = 1,
 ):
     """Serving-path step: binds request operands onto a layout-keyed
     dynamic program (see module docstring).  Same contract as
-    ``build_search_step``."""
+    ``build_search_step``: one dispatch covers ``launch_steps *
+    chunks_per_step * tb_count`` candidates."""
     model = get_hash_model(model_name)
     spec = build_tail_spec(bytes(nonce), width, model, extra_const_chunk)
     init, base, masks = step_operands(spec, difficulty, model)
@@ -230,7 +291,7 @@ def cached_search_step(
     pow2 = tb_count & (tb_count - 1) == 0
     dyn = _dyn_search_step(
         model_name, spec.n_blocks, spec.tb_loc, spec.chunk_locs, batch,
-        None if pow2 else tb_count,
+        None if pow2 else tb_count, launch_steps,
     )
     if pow2:
         log_tbc = jnp.uint32(tb_count.bit_length() - 1)

@@ -50,6 +50,18 @@ from .search import SearchResult, StepFactory, contiguous_bounds, search
 AXIS = "workers"
 
 
+def _pvary(x, axis: str):
+    """Mark a replicated value as varying over ``axis`` (shard_map's
+    varying-manual-axes typing); name differs across JAX versions."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, (axis,), to="varying")
+    fn = getattr(jax.lax, "pvary", None)
+    if fn is not None:
+        return fn(x, (axis,))
+    return x
+
+
 def make_mesh(devices: Optional[Sequence] = None, axis: str = AXIS) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devs), (axis,))
@@ -66,6 +78,7 @@ def _dyn_mesh_step(
     batch_local: int,
     tb_split: bool,
     log_ndev: int,
+    launch_steps: int = 1,
 ):
     """Layout-keyed jitted mesh step (the dynamic regime of
     ops/search_step.py, spread over the device mesh).
@@ -73,9 +86,16 @@ def _dyn_mesh_step(
     Returned fn: ``(init[S], base[n_blocks,16], masks[D],
     part[2]=(tb_lo, log_tbc), chunk0) -> uint32`` — the *global* first-hit
     flat index after the ``lax.pmin`` collective, or SENTINEL.
+
+    ``launch_steps`` consecutive sub-batches run per dispatch in an
+    on-device fori_loop (see ops/search_step.py); each sub-batch advances
+    the global flat index by ``batch_local * n_dev`` and the chunk base by
+    the same count of candidates — identical in both sharding regimes, so
+    the loop body is regime-agnostic.
     """
     model = get_hash_model(model_name)
     one = jnp.uint32(1)
+    batch_global = batch_local << log_ndev
 
     def body(init, base, masks, part, chunk0):
         d = jax.lax.axis_index(axis).astype(jnp.uint32)
@@ -83,22 +103,39 @@ def _dyn_mesh_step(
         fl = jnp.arange(batch_local, dtype=jnp.uint32)
         if tb_split:
             log_tbl = log_tbc - jnp.uint32(log_ndev)
-            chunk_off = fl >> log_tbl
+            chunk_off0 = fl >> log_tbl
             tb_local = fl & ((one << log_tbl) - one)
             tb = tb_lo + (d << log_tbl) + tb_local
-            f_global = (chunk_off << log_tbc) + (d << log_tbl) + tb_local
+            f_global0 = (chunk_off0 << log_tbc) + (d << log_tbl) + tb_local
         else:
             chunks_local = jnp.uint32(batch_local) >> log_tbc
-            chunk_off = d * chunks_local + (fl >> log_tbc)
+            chunk_off0 = d * chunks_local + (fl >> log_tbc)
             tb_idx = fl & ((one << log_tbc) - one)
             tb = tb_lo + tb_idx
-            f_global = (chunk_off << log_tbc) + tb_idx
-        chunk = jnp.uint32(chunk0) + chunk_off
-        state = eval_dyn_candidates(
-            model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
-        )
-        hit = fold_dyn_masks(model, state, masks)
-        m = jnp.min(jnp.where(hit, f_global, jnp.uint32(SENTINEL)))
+            f_global0 = (chunk_off0 << log_tbc) + tb_idx
+        gchunks = jnp.uint32(batch_global) >> log_tbc  # chunks per sub-batch
+
+        def sub(i):
+            chunk = jnp.uint32(chunk0) + chunk_off0 + i * gchunks
+            state = eval_dyn_candidates(
+                model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
+            )
+            hit = fold_dyn_masks(model, state, masks)
+            f_global = f_global0 + i * jnp.uint32(batch_global)
+            return jnp.min(jnp.where(hit, f_global, jnp.uint32(SENTINEL)))
+
+        if launch_steps == 1:
+            m = sub(jnp.uint32(0))
+        else:
+            # the loop carry must already be device-varying (its updates
+            # depend on axis_index), or shard_map rejects the fori_loop
+            init_best = _pvary(jnp.uint32(SENTINEL), axis)
+            m = jax.lax.fori_loop(
+                0,
+                launch_steps,
+                lambda i, best: jnp.minimum(best, sub(i.astype(jnp.uint32))),
+                init_best,
+            )
         return jax.lax.pmin(m, axis)
 
     sharded = jax.shard_map(
@@ -121,13 +158,13 @@ def _mesh_step_factory(
     pow2 = (tbc & (tbc - 1)) == 0 and (n_dev & (n_dev - 1)) == 0
 
     @functools.lru_cache(maxsize=32)
-    def bind_dyn(vw: int, extra: bytes, chunks_local: int):
+    def bind_dyn(vw: int, extra: bytes, chunks_local: int, launch_steps: int):
         spec = build_tail_spec(bytes(nonce), vw, model, extra)
         tbl = tbc // n_dev if tb_split else tbc
         dyn = _dyn_mesh_step(
             mesh, axis, model.name, spec.n_blocks, spec.tb_loc,
             spec.chunk_locs, chunks_local * tbl, tb_split,
-            n_dev.bit_length() - 1,
+            n_dev.bit_length() - 1, launch_steps,
         )
         init, base, masks = step_operands(spec, difficulty, model)
         part = jnp.asarray([tb_lo, tbc.bit_length() - 1], jnp.uint32)
@@ -180,9 +217,7 @@ def _mesh_step_factory(
         sharded = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P())
         return jax.jit(sharded)
 
-    build = bind_dyn if pow2 else build_static
-
-    def factory(vw: int, extra: bytes, target_chunks: int):
+    def factory(vw: int, extra: bytes, target_chunks: int, launch_steps: int = 1):
         if vw == 0:
             # 256 candidates max — no mesh benefit; reuse the shared
             # layout-keyed width-0 probe (single device, warmup-covered)
@@ -205,8 +240,15 @@ def _mesh_step_factory(
             # because both are pow2-multiples of <=256)
             eb_local = max(256, (target_chunks * tbc // n_dev) // 256 * 256)
             chunks_local = max(1, eb_local // tbc)
-        step = build(vw, bytes(extra), chunks_local)
-        global_chunks = chunks_local if tb_split else chunks_local * n_dev
+        if pow2:
+            k = max(1, launch_steps)
+            step = bind_dyn(vw, bytes(extra), chunks_local, k)
+        else:
+            # nonce-keyed static fallback compiles per request anyway;
+            # multi-sub-batch launches are not worth a bespoke program
+            k = 1
+            step = build_static(vw, bytes(extra), chunks_local)
+        global_chunks = (chunks_local if tb_split else chunks_local * n_dev) * k
         return step, global_chunks
 
     return factory

@@ -25,8 +25,12 @@ has a zero top byte.  Those are not in the reference's canonical
 enumeration (its encodings are minimal) but they are perfectly valid
 secrets under the puzzle contract — any solving secret is acceptable
 (coordinator.go:202 takes whichever result arrives first) — so the driver
-accepts them rather than paying a tail recompile per width.  Every result
-is re-verified host-side with hashlib before being returned.
+accepts them rather than paying a tail recompile per width.  The launch
+multiplier widens the possible overrun to up to one full launch
+(``launch_steps * chunks_per_step`` chunks past the segment end), but a
+wrapped candidate can only win when no canonical candidate in the same
+launch solves (canonical flat indices sort first), and every result is
+re-verified host-side with hashlib before being returned.
 """
 
 from __future__ import annotations
@@ -41,6 +45,38 @@ from ..ops.search_step import SENTINEL, cached_search_step
 
 DEFAULT_BATCH = 1 << 20
 DEFAULT_PIPELINE_DEPTH = 2
+# Candidates one dispatch should cover.  Every launch costs one
+# host<->device round trip to fetch its first-hit index (tens of ms over a
+# remote-tunnel TPU), so a dispatch must carry enough work to amortize it;
+# steps run `launch_steps` sub-batches in an on-device fori_loop, keeping
+# materialized buffers at the (much smaller) batch size.
+DEFAULT_LAUNCH_CANDIDATES = 1 << 30
+
+
+def launch_steps_for(
+    vw: int,
+    sub_chunks: int,
+    tbc: int,
+    max_launch: int = DEFAULT_LAUNCH_CANDIDATES,
+) -> int:
+    """Launch multiplier for one width segment.
+
+    Pure function of (width, sub-batch candidate count, budget) — boot
+    warmup (backends._warm_layouts) and serving both call it, which is
+    what keeps the warmed compile keys identical to the served ones.
+    Everything is computed from ``sub_chunks * tbc`` (== effective_batch
+    for every power-of-two partition) and the width's CANONICAL 256-
+    thread-byte candidate volume, never from the partition's own chunk
+    count — the resulting k is identical across partitions, so it is safe
+    inside compile keys.  The segment cap bounds overscan on small widths
+    (a sub-256 partition may overscan its segment by at most 256/tbc)."""
+    if vw == 0 or sub_chunks < 1:
+        return 1
+    sub_cand = sub_chunks * tbc
+    seg_chunks = (1 << 32) if vw >= 4 else 256 ** vw - 256 ** (vw - 1)
+    k_seg = -(-(seg_chunks * 256) // sub_cand)
+    k_rtt = max_launch // sub_cand
+    return max(1, min(k_rtt, k_seg))
 
 
 def effective_batch(batch_size: int) -> int:
@@ -54,12 +90,15 @@ def effective_batch(batch_size: int) -> int:
     every pow2 tbc <= 256."""
     return max(256, batch_size - batch_size % 256)
 
-# A step factory maps (variable_width, extra_const_chunk, target_chunks) to
-# (step_fn, chunks_per_step) where step_fn(chunk0)->uint32 evaluates
-# chunks_per_step * tb_count candidates starting at chunk0 and returns the
-# flat index (chunk-major, thread-byte-minor, i.e. reference enumeration
-# order, worker.go:318-319) of the first hit, or SENTINEL.
-StepFactory = Callable[[int, bytes, int], Tuple[Callable, int]]
+# A step factory maps (variable_width, extra_const_chunk, target_chunks,
+# launch_steps) to (step_fn, chunks_per_step) where step_fn(chunk0)->uint32
+# evaluates chunks_per_step * tb_count candidates starting at chunk0 and
+# returns the flat index (chunk-major, thread-byte-minor, i.e. reference
+# enumeration order, worker.go:318-319) of the first hit, or SENTINEL.
+# ``launch_steps`` asks for that many target_chunks-sized sub-batches per
+# dispatch; a factory may serve fewer — the driver always trusts the
+# returned chunks_per_step.
+StepFactory = Callable[[int, bytes, int, int], Tuple[Callable, int]]
 
 
 @dataclass
@@ -109,13 +148,14 @@ def default_step_factory(
 ) -> StepFactory:
     """Single-device factory over the fused XLA search step."""
 
-    def factory(vw: int, extra: bytes, target_chunks: int):
+    def factory(vw: int, extra: bytes, target_chunks: int, launch_steps: int = 1):
         chunks = max(1, target_chunks) if vw else 1
+        k = launch_steps if vw else 1
         step = cached_search_step(
             bytes(nonce), vw, difficulty, tb_lo, tb_count,
-            chunks, model.name, extra,
+            chunks, model.name, extra, k,
         )
-        return step, chunks
+        return step, chunks * k
 
     return factory
 
@@ -132,6 +172,7 @@ def search(
     max_hashes: Optional[int] = None,
     max_width: int = 8,
     step_factory: Optional[StepFactory] = None,
+    launch_candidates: int = DEFAULT_LAUNCH_CANDIDATES,
 ) -> Optional[SearchResult]:
     """Find the first (reference-enumeration-order) solving secret.
 
@@ -195,7 +236,8 @@ def search(
 
     for width in range(0, max_width + 1):
         for vw, lo, hi, extra in width_segments(width):
-            step, chunks_per_step = factory(vw, extra, target_chunks)
+            k = launch_steps_for(vw, target_chunks, tbc, launch_candidates)
+            step, chunks_per_step = factory(vw, extra, target_chunks, k)
             n_cand = chunks_per_step * tbc
             chunk0 = lo
             while chunk0 < hi:

@@ -124,6 +124,48 @@ def test_mesh_search_sub_partition_and_chunk_split():
     assert got is not None and got.secret == oracle
 
 
+def test_launch_steps_partition_independent():
+    """The launch multiplier enters jit compile keys, so for a fixed
+    effective batch it must not depend on which pow2 partition a request
+    carries — else boot warmup (tbc=256) couldn't cover serving."""
+    from distpow_tpu.parallel.search import effective_batch, launch_steps_for
+
+    for batch_size in (1 << 13, 10_000, 1 << 21):
+        E = effective_batch(batch_size)
+        for vw in (1, 2, 3, 4):
+            ks = {launch_steps_for(vw, E // tbc, tbc) for tbc in (256, 64, 8, 1)}
+            assert len(ks) == 1, (batch_size, vw, ks)
+
+
+def test_search_small_launch_budget_matches_oracle():
+    """Multi-sub-batch dispatches (launch_steps > 1) preserve reference
+    enumeration order across sub-batch boundaries."""
+    nonce = b"\x0a\x0b\x0c\x0d"
+    for d in (2, 3):
+        oracle = puzzle.python_search(nonce, d, list(range(256)))
+        got = search(
+            nonce, d, list(range(256)), batch_size=1 << 13,
+            launch_candidates=1 << 16,
+        )
+        assert got is not None and got.secret == oracle
+
+
+def test_warmup_covers_sub_partitions_with_launch_steps():
+    """A worker warmed on the full 256-byte partition serves a 4-way
+    split (tbc=64) without any new dynamic compiles, launch multiplier
+    included."""
+    from distpow_tpu.backends import JaxBackend
+    from distpow_tpu.ops.search_step import _dyn_search_step
+
+    b = JaxBackend(batch_size=1 << 13)
+    b.warmup([4], [0, 1, 2])
+    misses = _dyn_search_step.cache_info().misses
+    secret = b.search(b"\x01\x01\x02\x03", 2, list(range(64, 128)))
+    assert secret is not None
+    assert puzzle.check_secret(b"\x01\x01\x02\x03", secret, 2)
+    assert _dyn_search_step.cache_info().misses == misses
+
+
 def test_mesh_warmup_covers_all_pow2_partitions():
     """Boot warmup must pre-compile both mesh regimes, and batch_local
     must be partition-independent even when the configured batch size is
