@@ -53,7 +53,9 @@ from ..parallel.partition import worker_bits as partition_worker_bits
 from ..runtime import actions as act
 from ..runtime.cache import ResultCache
 from ..runtime.config import CoordinatorConfig
-from ..runtime.rpc import RPCClient, RPCServer
+from concurrent.futures import TimeoutError as FutureTimeout
+
+from ..runtime.rpc import RPCClient, RPCError, RPCServer
 from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
 
 log = logging.getLogger("distpow.coordinator")
@@ -72,14 +74,21 @@ class CoordRPCHandler:
     """RPC service ``CoordRPCHandler`` (Mine / Result)."""
 
     def __init__(self, tracer: Tracer, worker_addrs: List[str],
-                 dial_retry_interval: float = 0.2):
+                 dial_retry_interval: float = 0.2,
+                 cache_file: Optional[str] = None,
+                 failure_policy: str = "error",
+                 failure_probe_secs: float = 1.0):
         self.tracer = tracer
         self.workers = [WorkerRef(a, i) for i, a in enumerate(worker_addrs)]
         # floor(log2(N)) with the reference's uint truncation
         # (coordinator.go:326); see parallel/partition.py for the
         # non-power-of-two coverage discussion.
         self.worker_bits = partition_worker_bits(len(worker_addrs))
-        self.result_cache = ResultCache()
+        self.result_cache = ResultCache(persist_path=cache_file or None)
+        if failure_policy not in ("error", "reassign"):
+            raise ValueError(f"unknown FailurePolicy {failure_policy!r}")
+        self.failure_policy = failure_policy
+        self.failure_probe_secs = failure_probe_secs
         self._tasks: Dict[TaskKey, "queue.Queue"] = {}
         self._tasks_lock = threading.Lock()
         self._key_locks: Dict[TaskKey, list] = {}
@@ -119,6 +128,12 @@ class CoordRPCHandler:
 
     # -- worker connections (coordinator.go:356-368) ------------------------
     def _initialize_workers(self) -> None:
+        """Dial-retry until all workers reachable (reference parity).
+
+        Under FailurePolicy="reassign" a permanently dead worker must not
+        wedge every future request, so each missing worker gets one dial
+        attempt and the protocol proceeds with the live subset.
+        """
         while True:
             pending = [w for w in self.workers if w.client is None]
             if not pending:
@@ -127,9 +142,77 @@ class CoordRPCHandler:
                 try:
                     w.client = RPCClient(w.addr)
                 except OSError as exc:
+                    if self.failure_policy == "reassign":
+                        log.warning("worker %d unreachable: %s",
+                                    w.worker_byte, exc)
+                        continue
                     log.info("waiting for worker %d: %s", w.worker_byte, exc)
                     time.sleep(self._dial_retry_interval)
                     break
+            else:
+                return
+
+    def _mark_dead(self, w: WorkerRef) -> None:
+        """Drop a failed connection; the next request re-dials
+        (recovered workers rejoin automatically)."""
+        if w.client is not None:
+            try:
+                w.client.close()
+            except OSError:
+                pass
+            w.client = None
+
+    def _probe_dead(self, refs) -> List[WorkerRef]:
+        """Liveness-check distinct workers; returns the dead ones."""
+        dead = []
+        for ref in {id(w): w for w in refs}.values():
+            try:
+                if ref.client is None:
+                    raise OSError("not connected")
+                # a hung worker counts as dead: bounded probe.
+                # concurrent.futures.TimeoutError is caught explicitly —
+                # it only aliases the OSError-derived builtin on 3.11+.
+                ref.client.call("WorkerRPCHandler.Ping", {}, timeout=2.0)
+            except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
+                log.warning("worker %d failed probe: %s", ref.worker_byte, exc)
+                self._mark_dead(ref)
+                dead.append(ref)
+        return dead
+
+    def _reap_dead(self, tasks, ledgers):
+        """Probe and prune dead workers' tasks; drops their entries from
+        the given ledgers.  Returns (surviving_tasks, orphaned_shards)."""
+        dead = self._probe_dead([w for w, _ in tasks])
+        if not dead:
+            return tasks, []
+        dead_ids = {id(w) for w in dead}
+        orphans = [s for w, s in tasks if id(w) in dead_ids]
+        for ledger in ledgers:
+            for s in orphans:
+                ledger.pop(s, None)
+        return [(w, s) for w, s in tasks if id(w) not in dead_ids], orphans
+
+    def _issue_shards(self, trace, nonce: bytes, ntz: int, tasks, shards):
+        """Place each shard on some live worker; shards that cannot be
+        placed right now stay pending for the next probe round (coverage
+        is never silently dropped)."""
+        pending: List[int] = []
+        for i, shard in enumerate(shards):
+            placed = False
+            w = None
+            while not placed:
+                live = list({id(x): x for x, _ in tasks}.values())
+                candidates = [x for x in live if x.client is not None]
+                if not candidates:
+                    break
+                w = candidates[i % len(candidates)]
+                placed = self._send_mine(trace, nonce, ntz, w, shard)
+                # a failed send marked w dead; retry the rest
+            if placed:
+                tasks.append((w, shard))
+            else:
+                pending.append(shard)
+        return tasks, pending
 
     # -- RPCs ---------------------------------------------------------------
     def Mine(self, params) -> dict:
@@ -152,33 +235,80 @@ class CoordRPCHandler:
                 return self._success_reply(trace, nonce, ntz, cached)
             return self._mine_miss(trace, nonce, ntz)
 
-    def _mine_miss(self, trace, nonce: bytes, ntz: int) -> dict:
-        self._initialize_workers()
-        n = len(self.workers)
-        key = (nonce, ntz)
-        results: "queue.Queue" = queue.Queue(maxsize=2 * n)
-        self._task_set(key, results)
-
-        for w in self.workers:
-            trace.record_action(
-                act.CoordinatorWorkerMine(
-                    nonce=nonce, num_trailing_zeros=ntz,
-                    worker_byte=w.worker_byte,
-                )
+    def _send_mine(self, trace, nonce: bytes, ntz: int, w: WorkerRef,
+                   worker_byte: int) -> bool:
+        """Issue one worker Mine; under "reassign" a failure marks the
+        worker dead and returns False instead of raising."""
+        trace.record_action(
+            act.CoordinatorWorkerMine(
+                nonce=nonce, num_trailing_zeros=ntz, worker_byte=worker_byte,
             )
+        )
+        try:
+            if w.client is None:
+                raise OSError(f"worker {w.worker_byte} not connected")
             w.client.call(
                 "WorkerRPCHandler.Mine",
                 {
                     "nonce": list(nonce),
                     "num_trailing_zeros": ntz,
-                    "worker_byte": w.worker_byte,
+                    "worker_byte": worker_byte,
                     "worker_bits": self.worker_bits,
                     "token": encode_token(trace.generate_token()),
                 },
             )
+            return True
+        except (OSError, RPCError, RuntimeError) as exc:
+            if self.failure_policy != "reassign":
+                raise
+            log.warning("worker %d failed Mine for shard %d: %s",
+                        w.worker_byte, worker_byte, exc)
+            self._mark_dead(w)
+            return False
 
-        # first-result-wins (coordinator.go:202-206)
-        first = results.get()
+    def _assign_shards(self, trace, nonce: bytes, ntz: int):
+        """Fan the shard per worker (coordinator.go:179-199); under
+        "reassign", shards of dead workers go to live ones (a worker can
+        mine a foreign worker_byte — the partition travels in the RPC).
+        Returns (tasks, pending_unplaced_shards)."""
+        tasks: List[Tuple[WorkerRef, int]] = []
+        orphans: List[int] = []
+        for w in self.workers:
+            if self._send_mine(trace, nonce, ntz, w, w.worker_byte):
+                tasks.append((w, w.worker_byte))
+            else:
+                orphans.append(w.worker_byte)
+        tasks, pending = self._issue_shards(trace, nonce, ntz, tasks, orphans)
+        if not tasks:
+            raise RuntimeError("no live workers to mine on")
+        return tasks, pending
+
+    def _mine_miss(self, trace, nonce: bytes, ntz: int) -> dict:
+        self._initialize_workers()
+        key = (nonce, ntz)
+        results: "queue.Queue" = queue.Queue()
+        self._task_set(key, results)
+        reassign = self.failure_policy == "reassign"
+        probe_t = self.failure_probe_secs if reassign else None
+
+        tasks, pending = self._assign_shards(trace, nonce, ntz)
+
+        # first-result-wins (coordinator.go:202-206); under "reassign",
+        # waiting is interleaved with liveness probes; orphaned and
+        # not-yet-placed shards are re-issued every round so coverage is
+        # never silently lost
+        while True:
+            try:
+                first = results.get(timeout=probe_t)
+                break
+            except queue.Empty:
+                tasks, orphans = self._reap_dead(tasks, ())
+                if not tasks:
+                    self._task_delete(key)
+                    raise RuntimeError("all workers died while mining")
+                tasks, pending = self._issue_shards(
+                    trace, nonce, ntz, tasks, pending + orphans
+                )
         if first["secret"] is None:
             raise RuntimeError(
                 "protocol violation: first worker message was a cancellation "
@@ -186,45 +316,88 @@ class CoordRPCHandler:
             )
         winner = bytes(first["secret"])
 
-        self._broadcast_found(trace, nonce, ntz, winner)
+        tasks = self._broadcast_found(trace, nonce, ntz, winner, tasks)
 
-        # 2N-ack ledger (coordinator.go:237-248)
-        seen = 1
+        # the 2-messages-per-task ack ledger (coordinator.go:237-248): the
+        # finder already delivered 1 message; every surviving task owes 2
+        remaining: Dict[int, int] = {}
+        for _, shard in tasks:
+            remaining[shard] = remaining.get(shard, 0) + 2
+        fb = int(first["worker_byte"])
+        if fb in remaining:
+            remaining[fb] -= 1
         late: List[dict] = []
-        while seen < 2 * n:
-            msg = results.get()
+        while any(v > 0 for v in remaining.values()):
+            try:
+                msg = results.get(timeout=probe_t)
+            except queue.Empty:
+                tasks, _ = self._reap_dead(tasks, (remaining,))
+                continue
             if msg["secret"] is not None:
                 late.append(msg)
                 log.info("late worker result: %s", msg["worker_byte"])
-            seen += 1
+            b = int(msg["worker_byte"])
+            if b in remaining:
+                remaining[b] -= 1
 
-        # late-result cache propagation (coordinator.go:250-280)
+        # late-result cache propagation (coordinator.go:250-280): each
+        # rebroadcast is acked once per task (cache-update-only round)
         for msg in late:
-            self._broadcast_found(trace, nonce, ntz, bytes(msg["secret"]))
-            for _ in range(n):
-                results.get()
+            tasks = self._broadcast_found(
+                trace, nonce, ntz, bytes(msg["secret"]), tasks
+            )
+            owed = {shard: 1 for _, shard in tasks}
+            while any(v > 0 for v in owed.values()):
+                try:
+                    m = results.get(timeout=probe_t)
+                except queue.Empty:
+                    tasks, _ = self._reap_dead(tasks, (owed,))
+                    continue
+                b = int(m["worker_byte"])
+                if b in owed:
+                    owed[b] -= 1
 
         self._task_delete(key)
         return self._success_reply(trace, nonce, ntz, winner)
 
-    def _broadcast_found(self, trace, nonce: bytes, ntz: int, secret: bytes) -> None:
-        for w in self.workers:
+    def _broadcast_found(
+        self,
+        trace,
+        nonce: bytes,
+        ntz: int,
+        secret: bytes,
+        tasks: List[Tuple[WorkerRef, int]],
+    ) -> List[Tuple[WorkerRef, int]]:
+        """Found-as-cancel+cache-install per task (coordinator.go:210-230);
+        returns the tasks whose worker took delivery."""
+        delivered: List[Tuple[WorkerRef, int]] = []
+        for w, shard in tasks:
             trace.record_action(
                 act.CoordinatorWorkerCancel(
-                    nonce=nonce, num_trailing_zeros=ntz,
-                    worker_byte=w.worker_byte,
+                    nonce=nonce, num_trailing_zeros=ntz, worker_byte=shard,
                 )
             )
-            w.client.call(
-                "WorkerRPCHandler.Found",
-                {
-                    "nonce": list(nonce),
-                    "num_trailing_zeros": ntz,
-                    "worker_byte": w.worker_byte,
-                    "secret": list(secret),
-                    "token": encode_token(trace.generate_token()),
-                },
-            )
+            try:
+                if w.client is None:
+                    raise OSError(f"worker {w.worker_byte} not connected")
+                w.client.call(
+                    "WorkerRPCHandler.Found",
+                    {
+                        "nonce": list(nonce),
+                        "num_trailing_zeros": ntz,
+                        "worker_byte": shard,
+                        "secret": list(secret),
+                        "token": encode_token(trace.generate_token()),
+                    },
+                )
+                delivered.append((w, shard))
+            except (OSError, RPCError, RuntimeError) as exc:
+                if self.failure_policy != "reassign":
+                    raise
+                log.warning("worker %d failed Found for shard %d: %s",
+                            w.worker_byte, shard, exc)
+                self._mark_dead(w)
+        return delivered
 
     def _success_reply(self, trace, nonce: bytes, ntz: int, secret: bytes) -> dict:
         trace.record_action(
@@ -273,7 +446,12 @@ class Coordinator:
             "coordinator", config.TracerServerAddr, config.TracerSecret,
             sink=sink,
         )
-        self.handler = CoordRPCHandler(self.tracer, list(config.Workers))
+        self.handler = CoordRPCHandler(
+            self.tracer, list(config.Workers),
+            cache_file=getattr(config, "CacheFile", "") or None,
+            failure_policy=getattr(config, "FailurePolicy", "error") or "error",
+            failure_probe_secs=getattr(config, "FailureProbeSecs", 1.0),
+        )
         self.server = RPCServer()
         self.server.register("CoordRPCHandler", self.handler)
         self.client_addr: Optional[str] = None
@@ -318,4 +496,5 @@ class Coordinator:
         for w in self.handler.workers:
             if w.client is not None:
                 w.client.close()
+        self.handler.result_cache.close()
         self.tracer.close()

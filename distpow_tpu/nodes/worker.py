@@ -59,11 +59,12 @@ def _key(params) -> TaskKey:
 class WorkerRPCHandler:
     """RPC service ``WorkerRPCHandler`` (Mine / Found / Cancel)."""
 
-    def __init__(self, tracer: Tracer, result_queue: "queue.Queue", backend):
+    def __init__(self, tracer: Tracer, result_queue: "queue.Queue", backend,
+                 cache_file: Optional[str] = None):
         self.tracer = tracer
         self.result_queue = result_queue
         self.backend = backend
-        self.result_cache = ResultCache()
+        self.result_cache = ResultCache(persist_path=cache_file or None)
         self._tasks: Dict[TaskKey, threading.Event] = {}
         self._tasks_lock = threading.Lock()
 
@@ -126,6 +127,12 @@ class WorkerRPCHandler:
             raise RuntimeError(f"no active task for cancel: {key}")
         ev.set()
         return {}
+
+    def Ping(self, params) -> dict:
+        """Liveness probe for the coordinator's failure detector
+        (FailurePolicy="reassign"; no reference equivalent — the
+        reference has no liveness checking, SURVEY.md section 5)."""
+        return {"worker_tasks": len(self._tasks)}
 
     # -- miner (worker.go:258-401) -----------------------------------------
     def _send_result(self, key: TaskKey, secret: Optional[bytes], trace) -> None:
@@ -199,8 +206,12 @@ class Worker:
             hash_model=config.HashModel,
             batch_size=config.BatchSize,
             mesh_devices=config.MeshDevices,
+            max_launch=config.MaxLaunchCandidates or None,
         )
-        self.handler = WorkerRPCHandler(self.tracer, self.result_queue, backend)
+        self.handler = WorkerRPCHandler(
+            self.tracer, self.result_queue, backend,
+            cache_file=getattr(config, "CacheFile", "") or None,
+        )
         self.server = RPCServer()
         self.server.register("WorkerRPCHandler", self.handler)
         self.bound_addr: Optional[str] = None
@@ -252,4 +263,5 @@ class Worker:
         self.result_queue.put(None)
         self.server.shutdown()
         self.coordinator.close()
+        self.handler.result_cache.close()
         self.tracer.close()

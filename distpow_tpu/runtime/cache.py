@@ -16,16 +16,30 @@ item 2 we deliberately de-duplicate — semantics are identical:
   coordinator.go:454) — the "dominance" order that keeps all replicas
   convergent regardless of result arrival order.  Replacement records
   ``CacheRemove`` then ``CacheAdd``; a dominated insert records nothing.
+
+Checkpoint/resume (a capability the reference lacks — its caches are
+in-memory only and a restarted node starts cold, coordinator.go:105-108,
+worker.go:98-101): pass ``persist_path`` and every accepted add is
+appended to a JSONL journal; on construction the journal is replayed
+through the same dominance order, so a restarted node resumes with the
+converged cache state.  Replay tolerates a truncated final line (torn
+write on crash) and compacts the journal when it has accumulated
+superseded entries.
 """
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
 
 from .actions import CacheAdd, CacheHit, CacheMiss, CacheRemove
 from .tracing import Trace
+
+log = logging.getLogger("distpow.cache")
 
 
 @dataclass
@@ -35,9 +49,76 @@ class CacheEntry:
 
 
 class ResultCache:
-    def __init__(self):
+    def __init__(self, persist_path: Optional[str] = None):
         self._entries: Dict[bytes, CacheEntry] = {}
         self._lock = threading.Lock()
+        self._journal = None
+        if persist_path:
+            lines, torn = self._replay(persist_path)
+            if torn or lines > 2 * len(self._entries):
+                # a torn tail MUST be rewritten before appending: a new
+                # record appended after a partial line would merge into
+                # one corrupt line and poison the next replay
+                self._compact(persist_path)
+            self._journal = open(persist_path, "a", encoding="ascii")
+
+    # -- persistence -------------------------------------------------------
+    def _replay(self, path: str):
+        """Load journal lines through the dominance order; returns
+        (lines_seen, torn) for the compaction decision."""
+        if not os.path.exists(path):
+            return 0, False
+        lines, torn = 0, False
+        with open(path, "r", encoding="ascii") as fh:
+            for raw in fh:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                lines += 1
+                try:
+                    rec = json.loads(raw)
+                    self.add(
+                        bytes.fromhex(rec["nonce"]),
+                        int(rec["ntz"]),
+                        bytes.fromhex(rec["secret"]),
+                        trace=None,
+                    )
+                except (ValueError, KeyError, TypeError) as exc:
+                    # torn tail write from a crash mid-append: stop here
+                    log.warning("cache journal %s: stopping replay at "
+                                "corrupt line %d (%s)", path, lines, exc)
+                    torn = True
+                    break
+        log.info("cache journal %s: %d entries resumed from %d lines",
+                 path, len(self._entries), lines)
+        return lines, torn
+
+    def _compact(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="ascii") as fh:
+            for nonce, e in self._entries.items():
+                fh.write(json.dumps({
+                    "nonce": nonce.hex(),
+                    "ntz": e.num_trailing_zeros,
+                    "secret": e.secret.hex(),
+                }) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    def _append(self, nonce: bytes, ntz: int, secret: bytes) -> None:
+        if self._journal is None:
+            return
+        self._journal.write(json.dumps({
+            "nonce": nonce.hex(), "ntz": ntz, "secret": secret.hex(),
+        }) + "\n")
+        self._journal.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
 
     def get(
         self, nonce: bytes, num_trailing_zeros: int, trace: Optional[Trace]
@@ -74,6 +155,7 @@ class ResultCache:
             entry = self._entries.get(nonce)
             if entry is None:
                 self._entries[nonce] = CacheEntry(num_trailing_zeros, secret)
+                self._append(nonce, num_trailing_zeros, secret)
                 if trace:
                     trace.record_action(
                         CacheAdd(
@@ -105,6 +187,7 @@ class ResultCache:
                     )
                 )
             self._entries[nonce] = CacheEntry(num_trailing_zeros, secret)
+            self._append(nonce, num_trailing_zeros, secret)
             return True
 
     def peek(self, nonce: bytes) -> Optional[CacheEntry]:

@@ -58,6 +58,22 @@ class CoordinatorConfig:
     Workers: List[str] = field(default_factory=list)
     TracerServerAddr: str = ""
     TracerSecret: bytes = b""
+    # --- TPU-native extensions -------------------------------------------
+    # Checkpoint/resume: JSONL journal for the dominance result cache; a
+    # restarted coordinator resumes warm (the reference starts cold,
+    # coordinator.go:105-108).  Empty = in-memory only.
+    CacheFile: str = ""
+    # Failure handling for worker RPC errors mid-protocol:
+    #   "error"    — reference parity: the Mine RPC fails on any worker
+    #                error, no retry (coordinator.go:196-198, 227-229).
+    #   "reassign" — failure recovery: dead workers are detected (failed
+    #                calls + liveness probes while waiting) and their
+    #                search-space shard is reassigned to a live worker;
+    #                the ack ledger drops expectations from the dead.
+    FailurePolicy: str = "error"
+    # Probe cadence (seconds) while blocked on worker results in
+    # "reassign" mode.
+    FailureProbeSecs: float = 1.0
 
 
 @dataclass
@@ -72,6 +88,12 @@ class WorkerConfig:
     HashModel: str = "md5"
     BatchSize: int = 1 << 20
     MeshDevices: int = 0  # 0 = all local devices (jax-mesh backend)
+    # Candidates one device dispatch should cover (sub-batches of
+    # BatchSize run in an on-device loop).  Dispatch+result-fetch costs a
+    # host<->device round trip, so this bounds both the amortization of
+    # that cost and the cancellation latency (one launch).  0 = framework
+    # default (parallel/search.py DEFAULT_LAUNCH_CANDIDATES).
+    MaxLaunchCandidates: int = 0
     # Pre-compile the layout-keyed search programs for these nonce byte
     # lengths at boot (background thread), so the first Mine RPC is pure
     # dispatch.  The compiled programs are nonce-content-, difficulty- and
@@ -79,7 +101,11 @@ class WorkerConfig:
     # nonce *length* and chunk width key the compile.  Empty list = no
     # warmup.
     WarmupNonceLens: List[int] = field(default_factory=lambda: [2, 4])
-    WarmupWidths: List[int] = field(default_factory=lambda: [0, 1, 2, 3])
+    WarmupWidths: List[int] = field(default_factory=lambda: [0, 1, 2, 3, 4])
+    # Checkpoint/resume: JSONL journal for the worker's dominance cache
+    # (the reference's worker cache is memory-only, worker.go:98-101).
+    # Empty = in-memory only.
+    CacheFile: str = ""
 
 
 @dataclass

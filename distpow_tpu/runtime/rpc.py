@@ -111,6 +111,13 @@ class RPCServer:
                 conn, _ = ls.accept()
             except OSError:
                 return
+            if self._shutdown.is_set():
+                # the wake-up connection from shutdown(), or a late dial
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._lock:
                 self._conns.add(conn)
@@ -162,6 +169,22 @@ class RPCServer:
     def shutdown(self) -> None:
         self._shutdown.set()
         for ls in self._listeners:
+            # close() alone does NOT interrupt a thread parked in
+            # accept() on Linux — the listening description stays alive
+            # and the port keeps accepting.  Wake the acceptor with a
+            # throwaway connection first; it sees _shutdown and exits.
+            try:
+                host, port = ls.getsockname()[:2]
+                if host == "0.0.0.0":
+                    host = "127.0.0.1"
+                elif host == "::":
+                    # V6ONLY listener (create_server default): the wake
+                    # connection must itself be IPv6
+                    host = "::1"
+                with socket.create_connection((host, port), timeout=0.5):
+                    pass
+            except OSError:
+                pass
             try:
                 ls.close()
             except OSError:

@@ -26,13 +26,18 @@ class Stack:
     (Coordinator.set_worker_addrs) — no probe-then-rebind port races.
     """
 
-    def __init__(self, n_workers: int, backend: str = "python", difficulty_model="md5"):
+    def __init__(self, n_workers: int, backend: str = "python", difficulty_model="md5",
+                 coord_cache_file: str = "", failure_policy: str = "error",
+                 failure_probe_secs: float = 0.2):
         self.sinks = {"coordinator": MemorySink()}
         self.coordinator = Coordinator(
             CoordinatorConfig(
                 ClientAPIListenAddr="127.0.0.1:0",
                 WorkerAPIListenAddr="127.0.0.1:0",
                 Workers=["pending:0"] * n_workers,
+                CacheFile=coord_cache_file,
+                FailurePolicy=failure_policy,
+                FailureProbeSecs=failure_probe_secs,
             ),
             sink=self.sinks["coordinator"],
         )
@@ -187,6 +192,79 @@ def test_cache_hit_skips_fanout(stack1):
     # no new fan-out; the hit path records CacheHit then CoordinatorSuccess
     assert coord_after.count("CoordinatorWorkerMine") == n_mines
     assert coord_after[-2:] == ["CacheHit", "CoordinatorSuccess"]
+
+
+def test_reassign_dead_worker_at_fanout():
+    """FailurePolicy="reassign": a worker that is down when the request
+    arrives has its shard reassigned to a live worker, and the request
+    still completes (the reference would fail the Mine RPC,
+    coordinator.go:196-198; divergence documented in config.py)."""
+    s = Stack(2, failure_policy="reassign")
+    try:
+        s.workers[1].shutdown()  # worker2 is gone before the first request
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x61\x62", 2, timeout=30)
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        coord = s.action_names("coordinator")
+        # 2 fan-out attempts + 1 reassignment of the dead worker's shard
+        assert coord.count("CoordinatorWorkerMine") == 3
+        mines = [a[2]["worker_byte"] for a in s.sinks["coordinator"].actions()
+                 if a[1] == "CoordinatorWorkerMine"]
+        assert sorted(mines) == [0, 1, 1]  # shard 1 re-issued
+    finally:
+        s.close()
+
+
+def test_reassign_worker_dies_mid_protocol():
+    """A worker that dies while mining stops acking; the ledger drops its
+    expectations after a failed Found/probe and the Mine still returns."""
+    s = Stack(2, failure_policy="reassign")
+    try:
+        client = s.new_client("client1")
+        client.mine(b"\x63\x64", 4)  # ~65K python hashes: slow enough
+        time.sleep(0.15)
+        s.workers[1].server.shutdown()  # inbound RPCs (Found/Ping) now fail
+        res = client.notify_queue.get(timeout=60)
+        assert puzzle.check_secret(res.nonce, res.secret, 4)
+    finally:
+        s.close()
+
+
+def test_error_policy_is_reference_parity():
+    """Default FailurePolicy="error": worker failure fails the Mine."""
+    s = Stack(2)  # default error policy
+    try:
+        s.workers[1].shutdown()
+        client = s.new_client("client1")
+        client.mine(b"\x65\x66", 2)
+        # powlib surfaces the coordinator-side RPC error; with the
+        # busy-retry dial (coordinator.go:169-172) the request never
+        # completes — assert no result arrives within a short window
+        with pytest.raises(queue.Empty):
+            client.notify_queue.get(timeout=1.0)
+    finally:
+        s.close()
+
+
+def test_coordinator_cache_resume_across_restart(tmp_path):
+    """Checkpoint/resume at the node level: a restarted coordinator
+    serves a previously-solved nonce from its journal without re-mining
+    (the reference restarts cold, coordinator.go:105-108)."""
+    cache_file = str(tmp_path / "coord_cache.jsonl")
+    s1 = Stack(1, coord_cache_file=cache_file)
+    c1 = s1.new_client("client1")
+    r1 = mine_and_wait(c1, b"\x42\x43", 2)
+    s1.close()
+
+    s2 = Stack(1, coord_cache_file=cache_file)
+    c2 = s2.new_client("client1")
+    r2 = mine_and_wait(c2, b"\x42\x43", 2)
+    assert r2.secret == r1.secret
+    coord = s2.action_names("coordinator")
+    # pure cache hit: no fan-out after restart
+    assert coord.count("CoordinatorWorkerMine") == 0
+    assert "CacheHit" in coord
+    s2.close()
 
 
 def test_dominance_supersede_demo_scenario(stack1):

@@ -165,6 +165,66 @@ def test_cache_property_convergence():
     assert finals == {(3, b"\x07")}
 
 
+def test_cache_persist_and_resume(tmp_path):
+    """Checkpoint/resume: a cache journal replays to identical converged
+    state across restarts (capability the reference lacks; its caches are
+    memory-only, coordinator.go:105-108, worker.go:98-101)."""
+    path = str(tmp_path / "cache.jsonl")
+    c1 = ResultCache(persist_path=path)
+    c1.add(b"\x01\x02", 3, b"\xaa", None)
+    c1.add(b"\x01\x02", 5, b"\xbb", None)   # supersedes
+    c1.add(b"\x03\x04", 2, b"\xcc", None)
+    c1.add(b"\x01\x02", 4, b"\xdd", None)   # dominated: not journaled
+    c1.close()
+
+    c2 = ResultCache(persist_path=path)
+    assert len(c2) == 2
+    assert c2.get(b"\x01\x02", 5, None) == b"\xbb"
+    assert c2.get(b"\x03\x04", 2, None) == b"\xcc"
+    c2.add(b"\x05\x06", 1, b"\xee", None)   # journal keeps appending
+    c2.close()
+
+    c3 = ResultCache(persist_path=path)
+    assert len(c3) == 3 and c3.get(b"\x05\x06", 1, None) == b"\xee"
+    c3.close()
+
+
+def test_cache_journal_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "cache.jsonl")
+    c1 = ResultCache(persist_path=path)
+    c1.add(b"\x01", 3, b"\xaa", None)
+    c1.close()
+    with open(path, "a") as fh:
+        fh.write('{"nonce": "02", "ntz": 4, "sec')  # crash mid-append
+    c2 = ResultCache(persist_path=path)
+    assert len(c2) == 1 and c2.get(b"\x01", 3, None) == b"\xaa"
+    # appending after a torn tail must NOT merge into the partial line —
+    # the journal is compacted at open, so the next restart sees all
+    # post-crash entries
+    c2.add(b"\x03", 2, b"\xbb", None)
+    c2.close()
+    c3 = ResultCache(persist_path=path)
+    assert len(c3) == 2 and c3.get(b"\x03", 2, None) == b"\xbb"
+    c3.close()
+
+
+def test_cache_journal_compaction(tmp_path):
+    """A journal full of superseded entries is rewritten at load."""
+    path = str(tmp_path / "cache.jsonl")
+    c1 = ResultCache(persist_path=path)
+    for ntz in range(1, 8):
+        c1.add(b"\x01", ntz, bytes([ntz]), None)  # 7 lines, 1 live entry
+    c1.close()
+    c2 = ResultCache(persist_path=path)
+    c2.close()
+    with open(path) as fh:
+        lines = [ln for ln in fh if ln.strip()]
+    assert len(lines) == 1
+    c3 = ResultCache(persist_path=path)
+    assert c3.get(b"\x01", 7, None) == bytes([7])
+    c3.close()
+
+
 # --- RPC --------------------------------------------------------------------
 
 class EchoService:
@@ -235,6 +295,20 @@ def test_rpc_many_concurrent_calls(rpc_pair):
     _, cli, _ = rpc_pair
     futs = [cli.go("Echo.Add", {"a": i, "b": i}) for i in range(100)]
     assert [f.result(5)["sum"] for f in futs] == [2 * i for i in range(100)]
+
+
+def test_rpc_shutdown_stops_accepting():
+    """shutdown() must actually release the listener: close() alone does
+    not wake a thread blocked in accept(), leaving the port serving."""
+    srv = RPCServer()
+    srv.register("Echo", EchoService())
+    addr = srv.listen("127.0.0.1:0")
+    srv.serve_in_background()
+    RPCClient(addr).call("Echo.Echo", {"x": 1})
+    srv.shutdown()
+    time.sleep(0.1)
+    with pytest.raises((OSError, RPCError)):
+        RPCClient(addr, timeout=0.5).call("Echo.Echo", {}, timeout=0.5)
 
 
 def test_rpc_multiple_listeners():
