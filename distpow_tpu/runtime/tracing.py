@@ -191,14 +191,17 @@ class Tracer:
                 self._vc[ident] = max(self._vc.get(ident, 0), clock)
             self._tick_locked()
             vc = dict(self._vc)
-        self._emit(
-            {
-                "type": "receive_token",
-                "identity": self.identity,
-                "trace_id": data["trace_id"],
-                "vc": vc,
-            }
-        )
+            # emit INSIDE the lock: clock tick and wire order must agree,
+            # or concurrent threads ship events out of clock order and the
+            # ShiViz happens-before stream is corrupt
+            self._emit(
+                {
+                    "type": "receive_token",
+                    "identity": self.identity,
+                    "trace_id": data["trace_id"],
+                    "vc": vc,
+                }
+            )
         return Trace(self, data["trace_id"])
 
     def close(self) -> None:
@@ -212,29 +215,29 @@ class Tracer:
         with self._lock:
             self._tick_locked()
             vc = dict(self._vc)
-        self._emit(
-            {
-                "type": "action",
-                "identity": self.identity,
-                "trace_id": trace_id,
-                "action": action.name,
-                "body": action.to_fields(),
-                "vc": vc,
-            }
-        )
+            self._emit(
+                {
+                    "type": "action",
+                    "identity": self.identity,
+                    "trace_id": trace_id,
+                    "action": action.name,
+                    "body": action.to_fields(),
+                    "vc": vc,
+                }
+            )
 
     def _generate_token(self, trace_id: int) -> Token:
         with self._lock:
             self._tick_locked()
             vc = dict(self._vc)
-        self._emit(
-            {
-                "type": "generate_token",
-                "identity": self.identity,
-                "trace_id": trace_id,
-                "vc": vc,
-            }
-        )
+            self._emit(
+                {
+                    "type": "generate_token",
+                    "identity": self.identity,
+                    "trace_id": trace_id,
+                    "vc": vc,
+                }
+            )
         return json.dumps({"trace_id": trace_id, "vc": vc}).encode()
 
     def _emit(self, event: dict) -> None:

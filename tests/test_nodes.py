@@ -28,8 +28,10 @@ class Stack:
 
     def __init__(self, n_workers: int, backend: str = "python", difficulty_model="md5",
                  coord_cache_file: str = "", failure_policy: str = "error",
-                 failure_probe_secs: float = 0.2):
-        self.sinks = {"coordinator": MemorySink()}
+                 failure_probe_secs: float = 0.2, sink_factory=None):
+        sink_factory = sink_factory or (lambda name: MemorySink())
+        self._sink_factory = sink_factory
+        self.sinks = {"coordinator": sink_factory("coordinator")}
         self.coordinator = Coordinator(
             CoordinatorConfig(
                 ClientAPIListenAddr="127.0.0.1:0",
@@ -47,7 +49,7 @@ class Stack:
         worker_addrs = []
         for i in range(n_workers):
             wid = f"worker{i + 1}"
-            self.sinks[wid] = MemorySink()
+            self.sinks[wid] = self._sink_factory(wid)
             w = Worker(
                 WorkerConfig(
                     WorkerID=wid,
@@ -67,7 +69,7 @@ class Stack:
         self.clients = []
 
     def new_client(self, cid: str) -> Client:
-        self.sinks[cid] = MemorySink()
+        self.sinks[cid] = self._sink_factory(cid)
         c = Client(
             ClientConfig(ClientID=cid, CoordAddr=self.coord_client_addr),
             sink=self.sinks[cid],
@@ -228,6 +230,75 @@ def test_reassign_worker_dies_mid_protocol():
         assert puzzle.check_secret(res.nonce, res.secret, 4)
     finally:
         s.close()
+
+
+def test_reassign_hung_worker_detected():
+    """A hung-but-connected worker (Mine RPC never returns) must be
+    detected via the bounded call timeout and its shard reassigned."""
+    s = Stack(2, failure_policy="reassign")
+    s.coordinator.handler._call_timeout = 1.0
+    try:
+        # worker2's Mine handler hangs forever (process alive, TCP open)
+        s.workers[1].handler.Mine = lambda params: time.sleep(3600)
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x67\x68", 2, timeout=30)
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        mines = [a[2]["worker_byte"] for a in s.sinks["coordinator"].actions()
+                 if a[1] == "CoordinatorWorkerMine"]
+        assert sorted(mines) == [0, 1, 1]
+    finally:
+        s.close()
+
+
+def test_failed_mine_does_not_leak_task_entry():
+    """Every exit path out of the miss protocol must release the task
+    queue — a flaky cluster must not grow the coordinator task table."""
+    s = Stack(1, failure_policy="reassign", failure_probe_secs=0.1)
+    try:
+        s.workers[0].shutdown()
+        client = s.new_client("client1")
+        client.mine(b"\x69\x6a", 2)  # all workers dead -> Mine errors
+        with pytest.raises(queue.Empty):
+            client.notify_queue.get(timeout=2.0)
+        deadline = time.time() + 5
+        while s.coordinator.handler._tasks and time.time() < deadline:
+            time.sleep(0.05)
+        assert s.coordinator.handler._tasks == {}
+    finally:
+        s.close()
+
+
+def test_orphaned_miner_self_cancels_on_cache_install():
+    """A miner whose coordinator abandoned it stops as soon as a
+    satisfying secret lands in the worker cache, delivering that secret
+    as its result instead of burning the backend forever."""
+    import queue as q
+
+    from distpow_tpu.backends import PythonBackend
+    from distpow_tpu.nodes.worker import WorkerRPCHandler
+    from distpow_tpu.runtime.tracing import MemorySink, Tracer
+
+    tracer = Tracer("workerX", MemorySink())
+    rq: "q.Queue" = q.Queue()
+    h = WorkerRPCHandler(tracer, rq, PythonBackend())
+    trace = tracer.create_trace()
+    token = trace.generate_token()
+    from distpow_tpu.runtime.tracing import encode_token
+
+    # difficulty 6 on the python backend would take ~hours: the miner
+    # must exit via the cache install, not by finding a secret
+    h.Mine({"nonce": [9, 9], "num_trailing_zeros": 6, "worker_byte": 0,
+            "worker_bits": 0, "token": encode_token(token)})
+    time.sleep(0.2)
+    secret = b"\x12\x34"  # any value; dominance only needs ntz >= 6
+    h.result_cache.add(b"\x09\x09", 6, secret, None)
+    res = rq.get(timeout=15)  # result delivered from the cache
+    assert bytes(res["secret"]) == secret
+    # the finisher is now blocked awaiting Found; deliver it
+    h.Found({"nonce": [9, 9], "num_trailing_zeros": 6, "worker_byte": 0,
+             "secret": list(secret), "token": encode_token(token)})
+    ack = rq.get(timeout=5)
+    assert ack["secret"] is None
 
 
 def test_error_policy_is_reference_parity():
