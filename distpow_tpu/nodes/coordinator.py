@@ -47,14 +47,13 @@ import logging
 import queue
 import threading
 import time
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Dict, List, Optional, Tuple
 
 from ..parallel.partition import worker_bits as partition_worker_bits
 from ..runtime import actions as act
 from ..runtime.cache import ResultCache
 from ..runtime.config import CoordinatorConfig
-from concurrent.futures import TimeoutError as FutureTimeout
-
 from ..runtime.rpc import RPCClient, RPCError, RPCServer
 from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
 
@@ -89,6 +88,10 @@ class CoordRPCHandler:
             raise ValueError(f"unknown FailurePolicy {failure_policy!r}")
         self.failure_policy = failure_policy
         self.failure_probe_secs = failure_probe_secs
+        # reassign mode bounds every worker RPC, so a hung-but-connected
+        # worker is detected like a crashed one; error mode keeps the
+        # reference's unbounded blocking calls
+        self._call_timeout = 10.0 if failure_policy == "reassign" else None
         self._tasks: Dict[TaskKey, "queue.Queue"] = {}
         self._tasks_lock = threading.Lock()
         self._key_locks: Dict[TaskKey, list] = {}
@@ -134,15 +137,21 @@ class CoordRPCHandler:
         wedge every future request, so each missing worker gets one dial
         attempt and the protocol proceeds with the live subset.
         """
+        reassign = self.failure_policy == "reassign"
         while True:
             pending = [w for w in self.workers if w.client is None]
             if not pending:
                 return
             for w in pending:
                 try:
-                    w.client = RPCClient(w.addr)
+                    # reassign mode: a short connect timeout so one
+                    # blackholed address can't stall every request for
+                    # the 10s default
+                    w.client = RPCClient(
+                        w.addr, timeout=2.0 if reassign else 10.0
+                    )
                 except OSError as exc:
-                    if self.failure_policy == "reassign":
+                    if reassign:
                         log.warning("worker %d unreachable: %s",
                                     w.worker_byte, exc)
                         continue
@@ -256,9 +265,10 @@ class CoordRPCHandler:
                     "worker_bits": self.worker_bits,
                     "token": encode_token(trace.generate_token()),
                 },
+                timeout=self._call_timeout,
             )
             return True
-        except (OSError, RPCError, RuntimeError) as exc:
+        except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
             if self.failure_policy != "reassign":
                 raise
             log.warning("worker %d failed Mine for shard %d: %s",
@@ -290,7 +300,18 @@ class CoordRPCHandler:
         self._task_set(key, results)
         reassign = self.failure_policy == "reassign"
         probe_t = self.failure_probe_secs if reassign else None
+        try:
+            return self._mine_miss_locked(
+                trace, nonce, ntz, results, reassign, probe_t
+            )
+        finally:
+            # every exit path (success, protocol violation, all-workers-
+            # dead, error-policy RPC failure) must release the task entry,
+            # or retries leak queues and late Results route to a zombie
+            self._task_delete(key)
 
+    def _mine_miss_locked(self, trace, nonce: bytes, ntz: int, results,
+                          reassign: bool, probe_t) -> dict:
         tasks, pending = self._assign_shards(trace, nonce, ntz)
 
         # first-result-wins (coordinator.go:202-206); under "reassign",
@@ -304,7 +325,6 @@ class CoordRPCHandler:
             except queue.Empty:
                 tasks, orphans = self._reap_dead(tasks, ())
                 if not tasks:
-                    self._task_delete(key)
                     raise RuntimeError("all workers died while mining")
                 tasks, pending = self._issue_shards(
                     trace, nonce, ntz, tasks, pending + orphans
@@ -357,8 +377,43 @@ class CoordRPCHandler:
                 if b in owed:
                     owed[b] -= 1
 
-        self._task_delete(key)
+        if reassign:
+            self._cancel_abandoned(trace, nonce, ntz, winner, tasks)
         return self._success_reply(trace, nonce, ntz, winner)
+
+    def _cancel_abandoned(self, trace, nonce: bytes, ntz: int,
+                          secret: bytes, tasks) -> None:
+        """Best-effort Found to every worker not among the surviving
+        tasks.  A worker falsely marked dead on a transient failure still
+        has miner threads running (and a finder may be blocked waiting for
+        its Found); once the blip heals, this installs the winning secret
+        — which also self-cancels its orphaned miners via the worker's
+        cache-aware cancel check — and unblocks any waiting finder.
+        Failures are ignored: a truly dead worker has nothing running."""
+        alive = {id(w) for w, _ in tasks}
+        for w in self.workers:
+            if id(w) in alive:
+                continue
+            try:
+                if w.client is None:
+                    w.client = RPCClient(w.addr, timeout=2.0)
+                w.client.call(
+                    "WorkerRPCHandler.Found",
+                    {
+                        "nonce": list(nonce),
+                        "num_trailing_zeros": ntz,
+                        "worker_byte": w.worker_byte,
+                        "secret": list(secret),
+                        "token": encode_token(trace.generate_token()),
+                    },
+                    timeout=self._call_timeout,
+                )
+                log.info("abandoned worker %d cancelled and re-synced",
+                         w.worker_byte)
+            except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
+                log.info("abandoned worker %d still unreachable: %s",
+                         w.worker_byte, exc)
+                self._mark_dead(w)
 
     def _broadcast_found(
         self,
@@ -389,9 +444,10 @@ class CoordRPCHandler:
                         "secret": list(secret),
                         "token": encode_token(trace.generate_token()),
                     },
+                    timeout=self._call_timeout,
                 )
                 delivered.append((w, shard))
-            except (OSError, RPCError, RuntimeError) as exc:
+            except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
                 if self.failure_policy != "reassign":
                     raise
                 log.warning("worker %d failed Found for shard %d: %s",

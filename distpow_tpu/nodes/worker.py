@@ -170,13 +170,29 @@ class WorkerRPCHandler:
             self._finish_found(key, cached, cancel_ev, trace)
             return
 
+        def cancel_check() -> bool:
+            # also stop when a satisfying secret lands in the cache
+            # mid-search (a Found for a sibling task, or one this
+            # coordinator could no longer deliver to us) — a worker the
+            # coordinator abandoned must not burn the device forever
+            return (cancel_ev.is_set()
+                    or self.result_cache.get(nonce, ntz, None) is not None)
+
         tbs = partition.thread_bytes(worker_byte, worker_bits)
         secret = self.backend.search(
-            nonce, ntz, tbs, cancel_check=cancel_ev.is_set
+            nonce, ntz, tbs, cancel_check=cancel_check
         )
         if secret is not None:
             self._finish_found(key, secret, cancel_ev, trace)
             return
+        if not cancel_ev.is_set():
+            cached = self.result_cache.get(nonce, ntz, None)
+            if cached is not None:
+                # cache-triggered stop: deliver the cached secret as this
+                # task's result so the owning request's protocol still
+                # sees a result, never a spurious first-message ACK
+                self._finish_found(key, cached, cancel_ev, trace)
+                return
 
         # cancelled mid-search: two nil ACKs (worker.go:320-345)
         trace.record_action(
