@@ -39,14 +39,22 @@ class PythonBackend:
         self.hash_model = hash_model
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
-        return puzzle.python_search(
+        from ..runtime.metrics import REGISTRY as metrics
+
+        secret = puzzle.python_search(
             nonce,
             difficulty,
             thread_bytes,
             algo=self.hash_model,
             cancel_check=cancel_check,
             cancel_poll_interval=1024,
+            on_progress=lambda n: metrics.inc("search.hashes", n),
         )
+        if secret is not None:
+            metrics.inc("search.found")
+        elif cancel_check is not None and cancel_check():
+            metrics.inc("search.cancelled")
+        return secret
 
 
 def _warm_factory(factory, widths, target_chunks, tbc, max_launch) -> None:

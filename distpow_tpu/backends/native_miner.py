@@ -18,6 +18,7 @@ from typing import Callable, Optional, Sequence
 
 from ..models import puzzle
 from ..parallel.search import contiguous_bounds
+from ..runtime.metrics import REGISTRY as metrics
 
 log = logging.getLogger("distpow.native")
 
@@ -123,6 +124,14 @@ class NativeBackend:
 
             threading.Thread(target=poll, daemon=True).start()
 
+        counted = 0
+
+        def account() -> None:
+            nonlocal counted
+            metrics.inc("search.hashes", hashes.value - counted)
+            metrics.inc("search.launches")
+            counted = hashes.value
+
         try:
             # the native path enumerates full-width chunk integers in
             # uint64 directly, so each width is one dense range (no
@@ -146,6 +155,7 @@ class NativeBackend:
                         ctypes.byref(hashes),
                         secret_buf,
                     )
+                    account()
                     if rc == 1:
                         secret = secret_buf.raw[: 1 + width]
                         if not puzzle.check_secret(nonce, secret, difficulty):
@@ -153,8 +163,10 @@ class NativeBackend:
                                 "native miner returned non-solving secret "
                                 f"{secret.hex()}"
                             )
+                        metrics.inc("search.found")
                         return secret
                     if rc == -1:
+                        metrics.inc("search.cancelled")
                         return None
                     if rc < 0:
                         raise RuntimeError(f"native miner error rc={rc}")

@@ -1,0 +1,62 @@
+"""Node metrics inspector (no reference equivalent — SURVEY.md section 5
+lists metrics as absent in the reference).
+
+    python -m distpow_tpu.cli.stats --addr HOST:PORT [--role auto|coordinator|worker]
+
+Dials the node's RPC port, calls its ``Stats`` method, and prints the
+JSON snapshot.  ``--role auto`` (default) tries the coordinator service
+name first, then the worker's.  For a coordinator, use the CLIENT-facing
+listen address.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from concurrent.futures import TimeoutError as FutureTimeout
+
+from ..runtime.rpc import RPCClient, RPCError
+
+
+def fetch_stats(addr: str, role: str = "auto", timeout: float = 5.0) -> dict:
+    services = {
+        "coordinator": ["CoordRPCHandler.Stats"],
+        "worker": ["WorkerRPCHandler.Stats"],
+        "auto": ["CoordRPCHandler.Stats", "WorkerRPCHandler.Stats"],
+    }[role]
+    client = RPCClient(addr, timeout=timeout)
+    try:
+        last: Exception = RuntimeError("no services tried")
+        for method in services:
+            try:
+                return client.call(method, {}, timeout=timeout)
+            except (RPCError, FutureTimeout) as exc:
+                # FutureTimeout is only an OSError alias on 3.11+
+                last = exc
+        raise last
+    finally:
+        client.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="print a distpow node's metrics")
+    ap.add_argument("--addr", required=True, help="node RPC address host:port")
+    ap.add_argument("--role", choices=["auto", "coordinator", "worker"],
+                    default="auto")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    try:
+        snap = fetch_stats(args.addr, args.role, args.timeout)
+    except (OSError, RPCError, FutureTimeout) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    try:
+        print(json.dumps(snap, indent=2, sort_keys=True))
+    except BrokenPipeError:  # e.g. piped into `head`
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

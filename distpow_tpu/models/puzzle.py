@@ -144,6 +144,7 @@ def python_search(
     max_candidates: Optional[int] = None,
     cancel_check: Optional[Callable[[], bool]] = None,
     cancel_poll_interval: int = 4096,
+    on_progress: Optional[Callable[[int], None]] = None,
 ) -> Optional[bytes]:
     """Reference-order brute force over ``iter_candidates`` using hashlib.
 
@@ -153,20 +154,28 @@ def python_search(
     per-candidate hex formatting cost noted in BASELINE.md).
 
     Returns the first solving secret, or None if ``max_candidates`` is
-    exhausted or ``cancel_check`` fires.
+    exhausted or ``cancel_check`` fires.  ``on_progress(n)`` is invoked
+    with the total candidates hashed before every exit (an injection
+    point for callers' accounting; this module stays side-effect-free).
     """
     nonce = bytes(nonce)
     tried = 0
+
+    def done(result):
+        if on_progress is not None:
+            on_progress(tried)
+        return result
+
     for _, _, secret in iter_candidates(thread_bytes, start=start_chunk):
         if cancel_check is not None and tried % cancel_poll_interval == 0:
             if cancel_check():
-                return None
+                return done(None)
         if max_candidates is not None and tried >= max_candidates:
-            return None
+            return done(None)
         tried += 1
         h = hashlib.new(algo)
         h.update(nonce)
         h.update(secret)
         if count_trailing_zero_nibbles(h.digest()) >= num_trailing_zeros:
-            return secret
-    return None
+            return done(secret)
+    return done(None)

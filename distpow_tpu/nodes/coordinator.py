@@ -53,6 +53,7 @@ from typing import Dict, List, Optional, Tuple
 from ..parallel.partition import worker_bits as partition_worker_bits
 from ..runtime import actions as act
 from ..runtime.cache import ResultCache
+from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import CoordinatorConfig
 from ..runtime.rpc import RPCClient, RPCError, RPCServer
 from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
@@ -219,12 +220,15 @@ class CoordRPCHandler:
                 # a failed send marked w dead; retry the rest
             if placed:
                 tasks.append((w, shard))
+                if w.worker_byte != shard:
+                    metrics.inc("coord.reassigned_shards")
             else:
                 pending.append(shard)
         return tasks, pending
 
     # -- RPCs ---------------------------------------------------------------
     def Mine(self, params) -> dict:
+        metrics.inc("coord.mine_rpcs")
         nonce = bytes(params["nonce"])
         ntz = int(params["num_trailing_zeros"])
         trace = self.tracer.receive_token(decode_token(params["token"]))
@@ -273,6 +277,7 @@ class CoordRPCHandler:
                 raise
             log.warning("worker %d failed Mine for shard %d: %s",
                         w.worker_byte, worker_byte, exc)
+            metrics.inc("coord.worker_failures")
             self._mark_dead(w)
             return False
 
@@ -312,6 +317,7 @@ class CoordRPCHandler:
 
     def _mine_miss_locked(self, trace, nonce: bytes, ntz: int, results,
                           reassign: bool, probe_t) -> dict:
+        metrics.inc("coord.fanouts")
         tasks, pending = self._assign_shards(trace, nonce, ntz)
 
         # first-result-wins (coordinator.go:202-206); under "reassign",
@@ -355,6 +361,7 @@ class CoordRPCHandler:
                 continue
             if msg["secret"] is not None:
                 late.append(msg)
+                metrics.inc("coord.late_results")
                 log.info("late worker result: %s", msg["worker_byte"])
             b = int(msg["worker_byte"])
             if b in remaining:
@@ -490,6 +497,21 @@ class CoordRPCHandler:
             return {}
         q.put(params)
         return {}
+
+    def Stats(self, params) -> dict:
+        """Metrics snapshot (runtime/metrics.py; no reference
+        equivalent).  ``python -m distpow_tpu.cli.stats`` prints it."""
+        snap = metrics.snapshot()
+        snap["role"] = "coordinator"
+        snap["workers"] = [
+            {"worker_byte": w.worker_byte, "addr": w.addr,
+             "connected": w.client is not None}
+            for w in self.workers
+        ]
+        snap["active_tasks"] = len(self._tasks)
+        snap["cache_entries"] = len(self.result_cache)
+        snap["failure_policy"] = self.failure_policy
+        return snap
 
 
 class Coordinator:

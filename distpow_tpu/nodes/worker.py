@@ -42,6 +42,7 @@ from ..backends import get_backend
 from ..parallel import partition
 from ..runtime import actions as act
 from ..runtime.cache import ResultCache
+from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import WorkerConfig
 from ..runtime.rpc import RPCClient, RPCServer
 from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
@@ -83,6 +84,7 @@ class WorkerRPCHandler:
 
     # -- RPCs ---------------------------------------------------------------
     def Mine(self, params) -> dict:
+        metrics.inc("worker.mine_rpcs")
         key = _key(params)
         cancel_ev = threading.Event()
         self._task_set(key, cancel_ev)
@@ -101,6 +103,7 @@ class WorkerRPCHandler:
         return {}
 
     def Found(self, params) -> dict:
+        metrics.inc("worker.found_rpcs")
         key = _key(params)
         secret = bytes(params["secret"])
         trace = self.tracer.receive_token(decode_token(params["token"]))
@@ -121,6 +124,7 @@ class WorkerRPCHandler:
         return {}
 
     def Cancel(self, params) -> dict:
+        metrics.inc("worker.cancel_rpcs")
         key = _key(params)
         ev = self._task_pop(key)
         if ev is None:
@@ -134,8 +138,19 @@ class WorkerRPCHandler:
         reference has no liveness checking, SURVEY.md section 5)."""
         return {"worker_tasks": len(self._tasks)}
 
+    def Stats(self, params) -> dict:
+        """Metrics snapshot (runtime/metrics.py; no reference
+        equivalent).  ``python -m distpow_tpu.cli.stats`` prints it."""
+        snap = metrics.snapshot()
+        snap["role"] = "worker"
+        snap["backend"] = type(self.backend).__name__
+        snap["active_tasks"] = len(self._tasks)
+        snap["cache_entries"] = len(self.result_cache)
+        return snap
+
     # -- miner (worker.go:258-401) -----------------------------------------
     def _send_result(self, key: TaskKey, secret: Optional[bytes], trace) -> None:
+        metrics.inc("worker.results_sent")
         self.result_queue.put(
             {
                 "nonce": list(key[0]),

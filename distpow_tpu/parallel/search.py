@@ -42,6 +42,7 @@ from typing import Callable, Optional, Sequence, Tuple
 from ..models import puzzle
 from ..models.registry import HashModel, get_hash_model
 from ..ops.search_step import SENTINEL, cached_search_step
+from ..runtime.metrics import REGISTRY as metrics
 
 DEFAULT_BATCH = 1 << 20
 DEFAULT_PIPELINE_DEPTH = 2
@@ -209,6 +210,7 @@ def search(
         nonlocal hashes
         res, chunk0, vw, extra, n_cand = inflight.popleft()
         hashes += n_cand
+        metrics.inc("search.hashes", n_cand)
         f = int(res)
         if f == SENTINEL:
             return None
@@ -242,17 +244,24 @@ def search(
             chunk0 = lo
             while chunk0 < hi:
                 if cancel_check is not None and cancel_check():
+                    metrics.inc("search.cancelled")
                     return None
                 if max_hashes is not None and hashes >= max_hashes:
-                    return drain_all()
+                    found = drain_all()
+                    if found is not None:
+                        metrics.inc("search.found")
+                    return found
                 res = step(chunk0 & 0xFFFFFFFF)
+                metrics.inc("search.launches")
                 inflight.append((res, chunk0, vw, extra, n_cand))
                 chunk0 += chunks_per_step
                 if len(inflight) >= pipeline_depth:
                     found = drain_one()
                     if found is not None:
+                        metrics.inc("search.found")
                         return found
             found = drain_all()
             if found is not None:
+                metrics.inc("search.found")
                 return found
     return None

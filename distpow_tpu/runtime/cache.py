@@ -37,6 +37,7 @@ from dataclasses import dataclass
 from typing import Dict, Optional
 
 from .actions import CacheAdd, CacheHit, CacheMiss, CacheRemove
+from .metrics import REGISTRY as metrics
 from .tracing import Trace
 
 log = logging.getLogger("distpow.cache")
@@ -53,8 +54,16 @@ class ResultCache:
         self._entries: Dict[bytes, CacheEntry] = {}
         self._lock = threading.Lock()
         self._journal = None
+        self._replaying = False
         if persist_path:
-            lines, torn = self._replay(persist_path)
+            # journal replay must not count as protocol cache traffic —
+            # a restart would otherwise report thousands of cache.add at
+            # uptime ~0
+            self._replaying = True
+            try:
+                lines, torn = self._replay(persist_path)
+            finally:
+                self._replaying = False
             if torn or lines > 2 * len(self._entries):
                 # a torn tail MUST be rewritten before appending: a new
                 # record appended after a partial line would merge into
@@ -127,6 +136,7 @@ class ResultCache:
         with self._lock:
             entry = self._entries.get(nonce)
             if entry is not None and entry.num_trailing_zeros >= num_trailing_zeros:
+                metrics.inc("cache.hit")
                 if trace:
                     trace.record_action(
                         CacheHit(
@@ -136,6 +146,7 @@ class ResultCache:
                         )
                     )
                 return entry.secret
+            metrics.inc("cache.miss")
             if trace:
                 trace.record_action(
                     CacheMiss(nonce=nonce, num_trailing_zeros=num_trailing_zeros)
@@ -154,6 +165,8 @@ class ResultCache:
         with self._lock:
             entry = self._entries.get(nonce)
             if entry is None:
+                if not self._replaying:
+                    metrics.inc("cache.add")
                 self._entries[nonce] = CacheEntry(num_trailing_zeros, secret)
                 self._append(nonce, num_trailing_zeros, secret)
                 if trace:
@@ -186,6 +199,9 @@ class ResultCache:
                         secret=secret,
                     )
                 )
+            if not self._replaying:
+                metrics.inc("cache.evict")
+                metrics.inc("cache.add")
             self._entries[nonce] = CacheEntry(num_trailing_zeros, secret)
             self._append(nonce, num_trailing_zeros, secret)
             return True

@@ -1,0 +1,67 @@
+"""Process-wide metrics registry (no reference equivalent — the
+reference's only observability is its tracing subsystem; SURVEY.md
+section 5 "Metrics: no counters").
+
+A deliberately tiny, dependency-free counter/gauge registry.  Every node
+process has one ``REGISTRY``; hot paths increment named counters and the
+node's ``Stats`` RPC ships a snapshot (see nodes/coordinator.py and
+nodes/worker.py; ``python -m distpow_tpu.cli.stats`` prints it).
+
+Counter names in use:
+
+* ``search.hashes``        — candidates evaluated (all backends)
+* ``search.launches``      — device dispatches
+* ``search.cancelled``     — searches stopped by a cancel check
+* ``search.found``         — searches that returned a secret
+* ``worker.mine_rpcs`` / ``worker.found_rpcs`` / ``worker.cancel_rpcs``
+* ``worker.results_sent``  — messages queued to the forwarder
+* ``coord.mine_rpcs`` / ``coord.fanouts`` / ``coord.late_results``
+* ``coord.worker_failures`` / ``coord.reassigned_shards``
+* ``cache.hit`` / ``cache.miss`` / ``cache.add`` / ``cache.evict``
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Union
+
+Number = Union[int, float]
+
+
+class Metrics:
+    def __init__(self):
+        self._counters: Dict[str, Number] = {}
+        self._gauges: Dict[str, Number] = {}
+        self._lock = threading.Lock()
+        self._start = time.time()
+
+    def inc(self, name: str, n: Number = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: Number) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def get(self, name: str) -> Number:
+        with self._lock:
+            return self._counters.get(name, self._gauges.get(name, 0))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "uptime_secs": round(time.time() - self._start, 3),
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+            }
+
+    def reset(self) -> None:
+        """Testing hook."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._start = time.time()
+
+
+REGISTRY = Metrics()

@@ -1,0 +1,101 @@
+"""Metrics subsystem tests: counters land during a real protocol run and
+the Stats RPC / CLI expose them (capability absent in the reference,
+SURVEY.md section 5)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from test_nodes import Stack, mine_and_wait  # noqa: E402
+
+from distpow_tpu.cli.stats import fetch_stats  # noqa: E402
+from distpow_tpu.runtime.metrics import REGISTRY, Metrics  # noqa: E402
+
+
+def test_metrics_registry_basics():
+    m = Metrics()
+    m.inc("a")
+    m.inc("a", 5)
+    m.gauge("g", 3.5)
+    snap = m.snapshot()
+    assert snap["counters"]["a"] == 6
+    assert snap["gauges"]["g"] == 3.5
+    assert snap["uptime_secs"] >= 0
+    m.reset()
+    assert m.snapshot()["counters"] == {}
+
+
+def test_stats_rpc_and_cli_after_protocol_run():
+    before = REGISTRY.snapshot()["counters"]
+    s = Stack(2)
+    try:
+        client = s.new_client("client1")
+        mine_and_wait(client, b"\x71\x72", 2)
+        mine_and_wait(client, b"\x71\x72", 2)  # second hits the cache
+
+        coord_stats = fetch_stats(s.coord_client_addr, role="coordinator")
+        assert coord_stats["role"] == "coordinator"
+        assert coord_stats["failure_policy"] == "error"
+        assert len(coord_stats["workers"]) == 2
+        assert all(w["connected"] for w in coord_stats["workers"])
+        c = coord_stats["counters"]
+
+        def delta(name):
+            return c.get(name, 0) - before.get(name, 0)
+
+        assert delta("coord.mine_rpcs") >= 2
+        assert delta("coord.fanouts") >= 1
+        assert delta("cache.hit") >= 1
+        assert delta("cache.add") >= 1
+        assert delta("worker.mine_rpcs") >= 2   # in-process: shared registry
+        assert delta("worker.results_sent") >= 4
+
+        worker_stats = fetch_stats(s.workers[0].bound_addr, role="worker")
+        assert worker_stats["role"] == "worker"
+        assert worker_stats["backend"] == "PythonBackend"
+        assert worker_stats["active_tasks"] == 0
+
+        auto = fetch_stats(s.coord_client_addr, role="auto")
+        assert auto["role"] == "coordinator"
+    finally:
+        s.close()
+
+
+def test_all_backends_count_hashes():
+    """search.hashes must move for every backend family (the jax paths
+    via the driver, python via the oracle's progress hook)."""
+    from distpow_tpu.backends import PythonBackend
+
+    before = REGISTRY.get("search.hashes")
+    found_before = REGISTRY.get("search.found")
+    secret = PythonBackend().search(b"\x01\x02", 2, list(range(256)))
+    assert secret is not None
+    assert REGISTRY.get("search.hashes") > before
+    assert REGISTRY.get("search.found") == found_before + 1
+
+
+def test_cache_replay_does_not_count(tmp_path):
+    from distpow_tpu.runtime.cache import ResultCache
+
+    path = str(tmp_path / "c.jsonl")
+    c = ResultCache(persist_path=path)
+    for i in range(5):
+        c.add(bytes([i]), 2, b"\x01", None)
+    c.close()
+    before = REGISTRY.get("cache.add")
+    c2 = ResultCache(persist_path=path)  # replays 5 lines
+    c2.close()
+    assert REGISTRY.get("cache.add") == before
+
+
+def test_stats_cli_main(capsys):
+    s = Stack(1)
+    try:
+        from distpow_tpu.cli.stats import main
+
+        assert main(["--addr", s.coord_client_addr]) == 0
+        out = capsys.readouterr().out
+        assert '"role": "coordinator"' in out
+    finally:
+        s.close()
