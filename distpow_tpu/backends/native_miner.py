@@ -124,13 +124,12 @@ class NativeBackend:
 
             threading.Thread(target=poll, daemon=True).start()
 
-        counted = 0
-
         def account() -> None:
-            nonlocal counted
-            metrics.inc("search.hashes", hashes.value - counted)
+            # the native call OVERWRITES its out-param each invocation
+            # (*out_hashes = hashes, native_miner.cc) — per-call totals,
+            # not accumulation
+            metrics.inc("search.hashes", hashes.value)
             metrics.inc("search.launches")
-            counted = hashes.value
 
         try:
             # the native path enumerates full-width chunk integers in

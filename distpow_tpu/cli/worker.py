@@ -2,10 +2,20 @@
 
     python -m distpow_tpu.cli.worker [--config PATH] [--id ID]
         [--listen ADDR] [--backend {python,jax,jax-mesh,pallas,native}]
+        [--jax-coordinator HOST:PORT --jax-num-processes N --jax-process-id I]
 
 ``--id`` and ``--listen`` override the config file the same way the
 reference's flags do (cmd/worker/main.go:15-16); ``--backend`` selects the
 compute path (TPU-native extension).
+
+Multi-host: the ``--jax-*`` flags (or ``JaxCoordinator`` etc. in the
+config) run ``jax.distributed.initialize`` before any backend is built,
+so a single ``jax-mesh`` worker's mesh spans every chip of a multi-host
+TPU slice — ``jax.devices()`` becomes the global device list and the
+prefix->core ``shard_map`` collectives ride ICI/DCN.  The coordinator
+still sees ONE worker RPC endpoint (run the worker CLI on process 0 of
+the slice; the other processes run the same command with their process
+id and serve only their chips).
 """
 
 from __future__ import annotations
@@ -13,7 +23,7 @@ from __future__ import annotations
 import argparse
 import logging
 
-from ..nodes.worker import Worker
+from ..nodes.worker import Worker, maybe_init_distributed
 from ..runtime.config import WorkerConfig, read_json_config
 
 
@@ -24,6 +34,11 @@ def main(argv=None) -> None:
     ap.add_argument("--id", help="Worker ID, e.g. worker1")
     ap.add_argument("--listen", help="Listen address, e.g. 127.0.0.1:5000")
     ap.add_argument("--backend", help="Compute backend override")
+    ap.add_argument("--jax-coordinator", default="",
+                    help="jax.distributed coordinator HOST:PORT "
+                         "(multi-host mesh)")
+    ap.add_argument("--jax-num-processes", type=int, default=1)
+    ap.add_argument("--jax-process-id", type=int, default=0)
     args = ap.parse_args(argv)
 
     config = read_json_config(args.config, WorkerConfig)
@@ -33,8 +48,12 @@ def main(argv=None) -> None:
         config.ListenAddr = args.listen
     if args.backend:
         config.Backend = args.backend
+    if args.jax_coordinator:
+        config.JaxCoordinator = args.jax_coordinator
+        config.JaxNumProcesses = args.jax_num_processes
+        config.JaxProcessId = args.jax_process_id
     logging.info("worker config: %s", config)
-    Worker(config).run_forever()
+    Worker(config).run_forever()  # Worker() runs the multi-host bootstrap
 
 
 if __name__ == "__main__":

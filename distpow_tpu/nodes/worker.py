@@ -52,6 +52,31 @@ log = logging.getLogger("distpow.worker")
 TaskKey = Tuple[bytes, int, int]  # (nonce, num_trailing_zeros, worker_byte)
 
 
+def maybe_init_distributed(coordinator: str, num_processes: int,
+                           process_id: int) -> None:
+    """Join a multi-host JAX cluster (no-op when ``coordinator`` is empty).
+
+    The TPU-native analogue of an NCCL/MPI world bootstrap: XLA's own
+    distributed runtime wires the hosts; all subsequent collectives (the
+    ``lax.pmin`` found-index reduction, parallel/mesh_search.py) run over
+    ICI within a host and DCN across hosts with no NCCL/MPI code.  Must
+    run before any backend is built.
+    """
+    if not coordinator:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "joined jax cluster via %s: process %d/%d, %d global devices",
+        coordinator, process_id, num_processes, len(jax.devices()),
+    )
+
+
 def _key(params) -> TaskKey:
     return (bytes(params["nonce"]), int(params["num_trailing_zeros"]),
             int(params["worker_byte"]))
@@ -226,6 +251,20 @@ class Worker:
 
     def __init__(self, config: WorkerConfig, sink=None):
         self.config = config
+        maybe_init_distributed(
+            getattr(config, "JaxCoordinator", ""),
+            getattr(config, "JaxNumProcesses", 1),
+            getattr(config, "JaxProcessId", 0),
+        )
+        if getattr(config, "CompilationCacheDir", ""):
+            # persist XLA compiles across boots (warmup becomes a cache
+            # read after the first run on a machine)
+            import jax
+
+            jax.config.update(
+                "jax_compilation_cache_dir", config.CompilationCacheDir
+            )
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         self.tracer = make_tracer(
             config.WorkerID, config.TracerServerAddr, config.TracerSecret,
             sink=sink,

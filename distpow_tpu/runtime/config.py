@@ -106,6 +106,18 @@ class WorkerConfig:
     # (the reference's worker cache is memory-only, worker.go:98-101).
     # Empty = in-memory only.
     CacheFile: str = ""
+    # Persistent XLA compilation cache directory: warmup compiles
+    # (~10-12s for the full width set on TPU) are paid once per machine
+    # instead of once per boot.  Empty = no persistent cache.
+    CompilationCacheDir: str = ""
+    # Multi-host mesh: when JaxCoordinator is set,
+    # jax.distributed.initialize runs before the backend is built, so a
+    # jax-mesh worker's shard_map spans every chip of a multi-host slice
+    # (collectives over ICI within a host, DCN across).  The --jax-*
+    # worker CLI flags override these.
+    JaxCoordinator: str = ""
+    JaxNumProcesses: int = 1
+    JaxProcessId: int = 0
 
 
 @dataclass

@@ -123,3 +123,33 @@ def test_multiprocess_demo_scenario(tmp_path):
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_worker_multihost_bootstrap_subprocess():
+    """The --jax-* flags join a jax.distributed cluster before backend
+    construction; a 1-process cluster over the virtual CPU mesh proves
+    the bootstrap + mesh-search path (multi-host DCN uses the identical
+    code with N processes).  Run in a subprocess: jax.distributed state
+    is process-global."""
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from distpow_tpu.cli.worker import maybe_init_distributed\n"
+        "maybe_init_distributed('127.0.0.1:23981', 1, 0)\n"
+        "assert jax.process_count() == 1\n"
+        "from distpow_tpu.parallel import search_mesh, make_mesh\n"
+        "from distpow_tpu.models import puzzle\n"
+        "r = search_mesh(b'\\x01\\x02', 2, list(range(256)),\n"
+        "                mesh=make_mesh(jax.devices()), batch_size=1<<13)\n"
+        "assert puzzle.check_secret(b'\\x01\\x02', r.secret, 2)\n"
+        "jax.distributed.shutdown()\n"
+        "print('MULTIHOST_BOOTSTRAP_OK')\n"
+    )
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO)
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=str(REPO),
+        capture_output=True, text=True, timeout=240,
+    )
+    assert "MULTIHOST_BOOTSTRAP_OK" in out.stdout, out.stderr[-2000:]

@@ -66,3 +66,27 @@ def test_native_backend_cancellation():
     threading.Timer(0.2, ev.set).start()
     secret = backend.search(b"\x01", 30, list(range(256)), cancel_check=ev.is_set)
     assert secret is None
+
+
+def test_native_backend_hash_accounting():
+    """search.hashes must total across range calls — the native library
+    OVERWRITES its out-param per call, so multi-call searches (small
+    range_size) previously recorded only deltas."""
+    from distpow_tpu.models import puzzle
+    from distpow_tpu.runtime.metrics import REGISTRY
+
+    backend = native.NativeBackend(n_threads=1, range_size=1 << 8)
+    before = REGISTRY.get("search.hashes")
+    secret = backend.search(b"\x01\x02", 3, list(range(256)))
+    assert secret is not None
+    counted = REGISTRY.get("search.hashes") - before
+    # exact total: replay the same search with the oracle and count
+    oracle_count = 0
+
+    def on_progress(n):
+        nonlocal oracle_count
+        oracle_count += n
+
+    assert puzzle.python_search(b"\x01\x02", 3, list(range(256)),
+                                on_progress=on_progress) == secret
+    assert counted == oracle_count

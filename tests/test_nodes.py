@@ -28,7 +28,8 @@ class Stack:
 
     def __init__(self, n_workers: int, backend: str = "python", difficulty_model="md5",
                  coord_cache_file: str = "", failure_policy: str = "error",
-                 failure_probe_secs: float = 0.2, sink_factory=None):
+                 failure_probe_secs: float = 0.2, sink_factory=None,
+                 worker_extra: dict = None):
         sink_factory = sink_factory or (lambda name: MemorySink())
         self._sink_factory = sink_factory
         self.sinks = {"coordinator": sink_factory("coordinator")}
@@ -57,6 +58,7 @@ class Stack:
                     CoordAddr=worker_api_addr,
                     Backend=backend,
                     HashModel=difficulty_model,
+                    **(worker_extra or {}),
                 ),
                 sink=self.sinks[wid],
             )
@@ -265,6 +267,33 @@ def test_failed_mine_does_not_leak_task_entry():
             time.sleep(0.05)
         assert s.coordinator.handler._tasks == {}
     finally:
+        s.close()
+
+
+def test_worker_compilation_cache_dir(tmp_path):
+    """CompilationCacheDir persists XLA compiles across boots: after a
+    jax-backend solve, the cache directory holds compiled programs."""
+    import jax
+
+    cache_dir = str(tmp_path / "xla_cache")
+    s = Stack(1, backend="jax",
+              worker_extra={"CompilationCacheDir": cache_dir,
+                            "BatchSize": 1 << 12,
+                            "WarmupNonceLens": [], "WarmupWidths": []})
+    try:
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+        # CPU-mesh compiles are faster than the production 0.5s
+        # persistence threshold; persist everything for the assertion
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x5a\x5b", 2)
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        import os
+        assert os.path.isdir(cache_dir) and len(os.listdir(cache_dir)) > 0
+    finally:
+        # the knob is process-global jax config: restore for later tests
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         s.close()
 
 
