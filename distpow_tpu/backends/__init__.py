@@ -67,16 +67,26 @@ def _warm_factory(factory, widths, target_chunks, tbc, max_launch) -> None:
         int(step(1))  # block_until_ready via the int() conversion
 
 
+# One representative difficulty per mask-word compile bucket
+# (ops/search_step.py mask_words_for: difficulties 1..8 share a program,
+# 9..16 the next, ...).  Two buckets cover difficulty <= 16 nibbles
+# (64 bits) — beyond any feasible puzzle; higher buckets compile on
+# demand.
+WARMUP_DIFFICULTIES = (1, 9)
+
+
 def _warm_layouts(build, nonce_lens, widths, batch_size, tbc=256,
                   max_launch=None) -> None:
-    """Warm the layout-keyed programs for every (nonce length, width).
+    """Warm the layout-keyed programs for every (nonce length, width,
+    mask-word bucket).
 
-    ``build(nonce, tbc) -> StepFactory`` builds the factory for the full
-    partition ``[0, tbc)``.  ``target_chunks`` and the per-width launch
-    multiplier are derived exactly the way the serving path derives them
-    (parallel/search.py: ``effective_batch`` with the same ``tbc``,
-    ``launch_steps_for`` with the same budget) — which is what makes the
-    warmed compile keys byte-identical to the ones serving dispatches.
+    ``build(nonce, tbc, difficulty) -> StepFactory`` builds the factory
+    for the full partition ``[0, tbc)``.  ``target_chunks`` and the
+    per-width launch multiplier are derived exactly the way the serving
+    path derives them (parallel/search.py: ``effective_batch`` with the
+    same ``tbc``, ``launch_steps_for`` with the same budget) — which is
+    what makes the warmed compile keys byte-identical to the ones serving
+    dispatches.
     """
     from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES, effective_batch
 
@@ -84,8 +94,9 @@ def _warm_layouts(build, nonce_lens, widths, batch_size, tbc=256,
         max_launch = DEFAULT_LAUNCH_CANDIDATES
     target = max(1, effective_batch(batch_size) // tbc)
     for L in nonce_lens:
-        _warm_factory(build(bytes(int(L)), tbc), widths, target, tbc,
-                      max_launch)
+        for difficulty in WARMUP_DIFFICULTIES:
+            _warm_factory(build(bytes(int(L)), tbc, difficulty), widths,
+                          target, tbc, max_launch)
 
 
 class JaxBackend:
@@ -107,12 +118,13 @@ class JaxBackend:
         The dynamic regime (ops/search_step.py) keys compiles on (tail
         layout, batch) only, so warming with a zero nonce of the right
         length and the full 256-byte partition covers every future nonce
-        of that length at any difficulty and any power-of-two partition.
+        of that length at any difficulty (one program per mask-word
+        bucket, WARMUP_DIFFICULTIES) and any power-of-two partition.
         """
         from ..parallel.search import default_step_factory
 
         _warm_layouts(
-            lambda nonce, tbc: default_step_factory(nonce, 1, 0, tbc, self.model),
+            lambda nonce, tbc, d: default_step_factory(nonce, d, 0, tbc, self.model),
             nonce_lens, widths, self.batch_size, max_launch=self.max_launch,
         )
 
@@ -177,8 +189,10 @@ class JaxMeshBackend:
                      n_dev)
             return
 
-        def build(nonce, tbc):
-            return _mesh_step_factory(nonce, 1, 0, tbc, self.model, mesh, AXIS)
+        def build(nonce, tbc, difficulty):
+            return _mesh_step_factory(
+                nonce, difficulty, 0, tbc, self.model, mesh, AXIS
+            )
 
         _warm_layouts(build, nonce_lens, widths, self.batch_size,
                       max_launch=self.max_launch)

@@ -86,7 +86,7 @@ class PallasBackend:
         from . import _warm_layouts
 
         _warm_layouts(
-            lambda nonce, tbc: self._factory(nonce, 1, 0, tbc),
+            lambda nonce, tbc, d: self._factory(nonce, d, 0, tbc),
             nonce_lens, widths, self.batch_size, max_launch=self.max_launch,
         )
 

@@ -34,11 +34,11 @@ def main(argv=None) -> None:
     ap.add_argument("--id", help="Worker ID, e.g. worker1")
     ap.add_argument("--listen", help="Listen address, e.g. 127.0.0.1:5000")
     ap.add_argument("--backend", help="Compute backend override")
-    ap.add_argument("--jax-coordinator", default="",
+    ap.add_argument("--jax-coordinator", default=None,
                     help="jax.distributed coordinator HOST:PORT "
                          "(multi-host mesh)")
-    ap.add_argument("--jax-num-processes", type=int, default=1)
-    ap.add_argument("--jax-process-id", type=int, default=0)
+    ap.add_argument("--jax-num-processes", type=int, default=None)
+    ap.add_argument("--jax-process-id", type=int, default=None)
     args = ap.parse_args(argv)
 
     config = read_json_config(args.config, WorkerConfig)
@@ -48,9 +48,14 @@ def main(argv=None) -> None:
         config.ListenAddr = args.listen
     if args.backend:
         config.Backend = args.backend
-    if args.jax_coordinator:
+    # each --jax-* flag independently overrides its config field, so a
+    # shared config can set JaxCoordinator while per-host invocations
+    # pass only --jax-process-id
+    if args.jax_coordinator is not None:
         config.JaxCoordinator = args.jax_coordinator
+    if args.jax_num_processes is not None:
         config.JaxNumProcesses = args.jax_num_processes
+    if args.jax_process_id is not None:
         config.JaxProcessId = args.jax_process_id
     logging.info("worker config: %s", config)
     Worker(config).run_forever()  # Worker() runs the multi-host bootstrap

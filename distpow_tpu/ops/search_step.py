@@ -31,7 +31,7 @@ Two compilation regimes:
 from __future__ import annotations
 
 import functools
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -132,11 +132,30 @@ def eval_dyn_candidates(model, n_blocks, tb_loc, chunk_locs, init, base, tb, chu
     return state
 
 
-def fold_dyn_masks(model, state, masks):
-    """Hit mask against runtime-operand difficulty masks."""
-    acc = state[0] & masks[0]
-    for i in range(1, model.digest_words):
-        acc = acc | (state[i] & masks[i])
+def mask_words_for(difficulty: int, model) -> int:
+    """Digest words the trailing-nibble masks can touch (from the end).
+
+    Trailing nibbles live in the LAST digest words (8 nibbles per uint32
+    word; ops/difficulty.py), so a difficulty <= 8 needs exactly one
+    significant mask word.  Making this count a COMPILE key (not the
+    difficulty itself) lets XLA dead-code-eliminate the rounds and final
+    adds that only feed unused digest words, while any difficulty within
+    the same bucket still shares one program.
+    """
+    return max(1, min(model.digest_words, -(-difficulty // 8)))
+
+
+def fold_dyn_masks(model, state, masks, mask_words: Optional[int] = None):
+    """Hit mask against runtime-operand difficulty masks.
+
+    ``masks`` holds the ``mask_words`` significant masks for the LAST
+    digest words (``step_operands`` slices them); None = all words.
+    """
+    d = model.digest_words
+    k = d if mask_words is None else mask_words
+    acc = state[d - k] & masks[0]
+    for i in range(1, k):
+        acc = acc | (state[d - k + i] & masks[i])
     return acc == 0
 
 
@@ -149,6 +168,7 @@ def _dyn_search_step(
     batch: int,
     static_tbc,  # None => power-of-two partition passed as log2 operand
     launch_steps: int = 1,
+    mask_words: int = 0,  # 0 => all digest words significant
 ):
     """Layout-keyed jitted step with nonce/difficulty/partition as operands.
 
@@ -162,6 +182,7 @@ def _dyn_search_step(
     """
     model = get_hash_model(model_name)
     _check_launch(batch, launch_steps)
+    mw = mask_words or model.digest_words
 
     if static_tbc is None:
 
@@ -171,7 +192,7 @@ def _dyn_search_step(
             state = eval_dyn_candidates(
                 model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
             )
-            hit = fold_dyn_masks(model, state, masks)
+            hit = fold_dyn_masks(model, state, masks, mw)
             return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
 
         def step(init, base, masks, tb_lo, log_tbc, chunk0):
@@ -197,7 +218,7 @@ def _dyn_search_step(
             state = eval_dyn_candidates(
                 model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
             )
-            hit = fold_dyn_masks(model, state, masks)
+            hit = fold_dyn_masks(model, state, masks, mw)
             return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
 
         def step(init, base, masks, tb_lo, chunk0):
@@ -217,7 +238,8 @@ def _dyn_search_step(
 
 
 @functools.lru_cache(maxsize=None)
-def _dyn_search_step_w0(model_name: str, n_blocks: int, tb_loc, chunk_locs):
+def _dyn_search_step_w0(model_name: str, n_blocks: int, tb_loc, chunk_locs,
+                        mask_words: int = 0):
     """Width-0 probe program: scan ALL 256 thread bytes, mask the ones
     outside the runtime partition.
 
@@ -230,6 +252,7 @@ def _dyn_search_step_w0(model_name: str, n_blocks: int, tb_loc, chunk_locs):
     step at width 0.
     """
     model = get_hash_model(model_name)
+    mw = mask_words or model.digest_words
 
     def step(init, base, masks, tb_lo, tbc, chunk0):
         del chunk0  # width 0: no chunk bytes
@@ -238,7 +261,7 @@ def _dyn_search_step_w0(model_name: str, n_blocks: int, tb_loc, chunk_locs):
             model, n_blocks, tb_loc, chunk_locs, init, base, tb,
             jnp.uint32(0),
         )
-        hit = fold_dyn_masks(model, state, masks)
+        hit = fold_dyn_masks(model, state, masks, mw)
         hit = hit & (tb >= tb_lo) & (tb < tb_lo + tbc)
         return jnp.min(jnp.where(hit, tb - tb_lo, jnp.uint32(SENTINEL)))
 
@@ -246,12 +269,17 @@ def _dyn_search_step_w0(model_name: str, n_blocks: int, tb_loc, chunk_locs):
 
 
 def step_operands(spec: TailSpec, difficulty: int, model: HashModel):
-    """Device operands binding one (nonce, difficulty) onto a dyn step."""
+    """Device operands binding one (nonce, difficulty) onto a dyn step.
+
+    The masks operand carries only the ``mask_words_for(difficulty)``
+    significant trailing words — its LENGTH is part of the jit compile
+    key, matching the ``mask_words`` the program was built with."""
     masks = nibble_masks(difficulty, model)
+    mw = mask_words_for(difficulty, model)
     return (
         jnp.asarray(spec.init_state, jnp.uint32),
         jnp.asarray(spec.base_words, jnp.uint32),
-        jnp.asarray(masks, jnp.uint32),
+        jnp.asarray(masks[model.digest_words - mw:], jnp.uint32),
     )
 
 
@@ -274,11 +302,12 @@ def cached_search_step(
     model = get_hash_model(model_name)
     spec = build_tail_spec(bytes(nonce), width, model, extra_const_chunk)
     init, base, masks = step_operands(spec, difficulty, model)
+    mw = mask_words_for(difficulty, model)
     tb_lo_op = jnp.uint32(tb_lo)
 
     if width == 0:
         w0 = _dyn_search_step_w0(
-            model_name, spec.n_blocks, spec.tb_loc, spec.chunk_locs
+            model_name, spec.n_blocks, spec.tb_loc, spec.chunk_locs, mw
         )
         tbc_op = jnp.uint32(tb_count)
 
@@ -291,7 +320,7 @@ def cached_search_step(
     pow2 = tb_count & (tb_count - 1) == 0
     dyn = _dyn_search_step(
         model_name, spec.n_blocks, spec.tb_loc, spec.chunk_locs, batch,
-        None if pow2 else tb_count, launch_steps,
+        None if pow2 else tb_count, launch_steps, mw,
     )
     if pow2:
         log_tbc = jnp.uint32(tb_count.bit_length() - 1)

@@ -43,6 +43,7 @@ from ..ops.search_step import (
     cached_search_step,
     eval_dyn_candidates,
     fold_dyn_masks,
+    mask_words_for,
     step_operands,
 )
 from .search import SearchResult, StepFactory, contiguous_bounds, search
@@ -79,6 +80,7 @@ def _dyn_mesh_step(
     tb_split: bool,
     log_ndev: int,
     launch_steps: int = 1,
+    mask_words: int = 0,  # 0 => all digest words significant
 ):
     """Layout-keyed jitted mesh step (the dynamic regime of
     ops/search_step.py, spread over the device mesh).
@@ -95,6 +97,7 @@ def _dyn_mesh_step(
     """
     model = get_hash_model(model_name)
     one = jnp.uint32(1)
+    mw = mask_words or model.digest_words
     batch_global = batch_local << log_ndev
 
     def body(init, base, masks, part, chunk0):
@@ -120,7 +123,7 @@ def _dyn_mesh_step(
             state = eval_dyn_candidates(
                 model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
             )
-            hit = fold_dyn_masks(model, state, masks)
+            hit = fold_dyn_masks(model, state, masks, mw)
             f_global = f_global0 + i * jnp.uint32(batch_global)
             return jnp.min(jnp.where(hit, f_global, jnp.uint32(SENTINEL)))
 
@@ -165,6 +168,7 @@ def _mesh_step_factory(
             mesh, axis, model.name, spec.n_blocks, spec.tb_loc,
             spec.chunk_locs, chunks_local * tbl, tb_split,
             n_dev.bit_length() - 1, launch_steps,
+            mask_words_for(difficulty, model),
         )
         init, base, masks = step_operands(spec, difficulty, model)
         part = jnp.asarray([tb_lo, tbc.bit_length() - 1], jnp.uint32)

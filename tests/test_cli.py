@@ -131,10 +131,16 @@ def test_worker_multihost_bootstrap_subprocess():
     the bootstrap + mesh-search path (multi-host DCN uses the identical
     code with N processes).  Run in a subprocess: jax.distributed state
     is process-global."""
+    import socket
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
     code = (
         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
         "from distpow_tpu.cli.worker import maybe_init_distributed\n"
-        "maybe_init_distributed('127.0.0.1:23981', 1, 0)\n"
+        f"maybe_init_distributed('127.0.0.1:{port}', 1, 0)\n"
         "assert jax.process_count() == 1\n"
         "from distpow_tpu.parallel import search_mesh, make_mesh\n"
         "from distpow_tpu.models import puzzle\n"

@@ -166,6 +166,24 @@ def test_dyn_step_compile_reuse_across_requests():
     assert _dyn_search_step.cache_info().misses == before.misses + 1
 
 
+def test_dyn_step_difficulty_bucket_sharing():
+    """Difficulties 1..8 share one compiled program (one significant mask
+    word); difficulty 9+ selects a second bucket and still matches the
+    static program."""
+    _dyn_search_step.cache_clear()
+    cached_search_step.cache_clear()
+    cached_search_step(b"\x31\x32\x33\x34", 2, 1, 0, 256, 16, "md5")
+    before = _dyn_search_step.cache_info()
+    for d in (2, 5, 8):
+        cached_search_step(b"\x31\x32\x33\x34", 2, d, 0, 256, 16, "md5")
+    assert _dyn_search_step.cache_info().misses == before.misses
+    nine = cached_search_step(b"\x31\x32\x33\x34", 2, 9, 0, 256, 16, "md5")
+    assert _dyn_search_step.cache_info().misses == before.misses + 1
+    static9 = build_search_step(b"\x31\x32\x33\x34", 2, 9, 0, 256, 16, MD5)
+    for c0 in (256, 5000):
+        assert int(nine(jnp.uint32(c0))) == int(static9(jnp.uint32(c0)))
+
+
 def test_dyn_step_non_pow2_partition_falls_back():
     nonce = b"\x0e\x0f"
     dyn = cached_search_step(nonce, 1, 1, 10, 96, 4, "md5")

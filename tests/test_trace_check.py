@@ -49,7 +49,16 @@ def run_demo_scenario(tmp_path, n_workers=2):
         mine_and_wait(c2, b"\x02\x02\x02\x02", 3)  # dominance supersede
     finally:
         stack.close()
-        time.sleep(0.4)  # let the server drain in-flight events
+        # drain deterministically: wait until the log stops growing (a
+        # fixed sleep flakes on a loaded machine)
+        deadline = time.time() + 10
+        last = -1
+        while time.time() < deadline:
+            size = out.stat().st_size if out.exists() else 0
+            if size == last:
+                break
+            last = size
+            time.sleep(0.3)
         server.close()
     return out, shiviz
 
