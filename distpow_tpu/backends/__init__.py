@@ -41,7 +41,15 @@ class PythonBackend:
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
         from ..runtime.metrics import REGISTRY as metrics
 
-        secret = puzzle.python_search(
+        def count_exit(reason: str) -> None:
+            # the loop reports why it exited; re-evaluating cancel_check
+            # here would misclassify budget exhaustion as a cancel when
+            # the condition flipped after the loop stopped (and would
+            # re-trigger the check's side effects)
+            if reason != "exhausted":
+                metrics.inc(f"search.{reason}")
+
+        return puzzle.python_search(
             nonce,
             difficulty,
             thread_bytes,
@@ -49,12 +57,8 @@ class PythonBackend:
             cancel_check=cancel_check,
             cancel_poll_interval=1024,
             on_progress=lambda n: metrics.inc("search.hashes", n),
+            on_exit=count_exit,
         )
-        if secret is not None:
-            metrics.inc("search.found")
-        elif cancel_check is not None and cancel_check():
-            metrics.inc("search.cancelled")
-        return secret
 
 
 def _warm_factory(factory, widths, target_chunks, tbc, max_launch) -> None:

@@ -72,10 +72,16 @@ def main(argv=None) -> None:
                     r = c.notify_queue.get(timeout=0.2)
                 except queue.Empty:
                     continue
-                logging.info(
-                    "MineResult nonce=%s difficulty=%d secret=%s",
-                    r.nonce.hex(), r.num_trailing_zeros, r.secret.hex(),
-                )
+                if r.error is not None:
+                    logging.error(
+                        "MineError nonce=%s difficulty=%d error=%s",
+                        r.nonce.hex(), r.num_trailing_zeros, r.error,
+                    )
+                else:
+                    logging.info(
+                        "MineResult nonce=%s difficulty=%d secret=%s",
+                        r.nonce.hex(), r.num_trailing_zeros, r.secret.hex(),
+                    )
                 remaining -= 1
     finally:
         client1.close()

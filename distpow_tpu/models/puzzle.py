@@ -145,6 +145,7 @@ def python_search(
     cancel_check: Optional[Callable[[], bool]] = None,
     cancel_poll_interval: int = 4096,
     on_progress: Optional[Callable[[int], None]] = None,
+    on_exit: Optional[Callable[[str], None]] = None,
 ) -> Optional[bytes]:
     """Reference-order brute force over ``iter_candidates`` using hashlib.
 
@@ -157,25 +158,32 @@ def python_search(
     exhausted or ``cancel_check`` fires.  ``on_progress(n)`` is invoked
     with the total candidates hashed before every exit (an injection
     point for callers' accounting; this module stays side-effect-free).
+    ``on_exit(reason)`` reports WHY the search returned — ``"found"``,
+    ``"cancelled"`` or ``"exhausted"`` — so callers never have to
+    re-evaluate ``cancel_check`` after the fact (the condition may have
+    changed since the loop observed it, and re-invoking it re-triggers
+    its side effects).
     """
     nonce = bytes(nonce)
     tried = 0
 
-    def done(result):
+    def done(result, reason):
         if on_progress is not None:
             on_progress(tried)
+        if on_exit is not None:
+            on_exit(reason)
         return result
 
     for _, _, secret in iter_candidates(thread_bytes, start=start_chunk):
         if cancel_check is not None and tried % cancel_poll_interval == 0:
             if cancel_check():
-                return done(None)
+                return done(None, "cancelled")
         if max_candidates is not None and tried >= max_candidates:
-            return done(None)
+            return done(None, "exhausted")
         tried += 1
         h = hashlib.new(algo)
         h.update(nonce)
         h.update(secret)
         if count_trailing_zero_nibbles(h.digest()) >= num_trailing_zeros:
-            return done(secret)
-    return done(None)
+            return done(secret, "found")
+    return done(None, "exhausted")

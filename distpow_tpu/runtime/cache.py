@@ -185,19 +185,22 @@ class ResultCache:
             if not dominates:
                 return False
             if trace:
-                trace.record_action(
+                # one tracer-lock critical section: the Remove/Add pair is
+                # adjacent in the reference trace (emitted from inside the
+                # cache mutex, coordinator.go:436-454) and trace_check.py
+                # asserts that adjacency — per-action locking would let a
+                # concurrent handler interleave an event between them
+                trace.record_actions(
                     CacheRemove(
                         nonce=nonce,
                         num_trailing_zeros=entry.num_trailing_zeros,
                         secret=entry.secret,
-                    )
-                )
-                trace.record_action(
+                    ),
                     CacheAdd(
                         nonce=nonce,
                         num_trailing_zeros=num_trailing_zeros,
                         secret=secret,
-                    )
+                    ),
                 )
             if not self._replaying:
                 metrics.inc("cache.evict")
@@ -210,6 +213,17 @@ class ResultCache:
         """Inspect without tracing (tests/diagnostics)."""
         with self._lock:
             return self._entries.get(bytes(nonce))
+
+    def satisfies(self, nonce: bytes, num_trailing_zeros: int) -> Optional[bytes]:
+        """Unmetered, untraced dominance lookup for hot polling paths
+        (the miner's between-batch cancel check) — ``get`` would swamp the
+        cache.hit/cache.miss counters with polling noise and is reserved
+        for protocol cache traffic."""
+        with self._lock:
+            entry = self._entries.get(bytes(nonce))
+            if entry is not None and entry.num_trailing_zeros >= num_trailing_zeros:
+                return entry.secret
+            return None
 
     def __len__(self) -> int:
         with self._lock:

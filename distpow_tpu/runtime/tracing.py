@@ -33,6 +33,7 @@ import os
 import socket
 import struct
 import threading
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from .actions import Action
@@ -160,6 +161,19 @@ class Trace:
     def record_action(self, action: Action) -> None:
         self.tracer._record(self.trace_id, action)
 
+    def record_actions(self, *actions: Action) -> None:
+        """Record several actions under ONE tracer-lock critical section.
+
+        Needed wherever an invariant spans a multi-action sequence — e.g.
+        the cache replacement pair CacheRemove→CacheAdd (coordinator.go:
+        436-454 emits them back-to-back from inside the cache mutex, so no
+        other action of the same node can interleave).  With per-action
+        locking a concurrent handler thread could slot an event between
+        them and the trace checker's adjacency invariant would (correctly)
+        flag the emitted order even though cache state was consistent.
+        """
+        self.tracer._record_many(self.trace_id, actions)
+
     def generate_token(self) -> Token:
         return self.tracer._generate_token(self.trace_id)
 
@@ -179,9 +193,14 @@ class Tracer:
     def create_trace(self) -> Trace:
         with self._lock:
             self._next_trace[0] += 1
-            # trace ids are unique per (identity, counter); fold the identity
-            # hash in so ids from different clients don't collide
-            tid = (hash(self.identity) & 0xFFFFFF) << 32 | self._next_trace[0]
+            # trace ids are unique per (identity, counter); fold a STABLE
+            # identity hash in so ids from different clients don't collide
+            # yet two runs of the same scenario yield the same ids — the
+            # golden-trace diff harness (tests/test_trace_parity.py)
+            # depends on run-to-run determinism, which Python's built-in
+            # hash() breaks via PYTHONHASHSEED randomization
+            ident_tag = zlib.crc32(self.identity.encode()) & 0xFFFFFFFF
+            tid = ident_tag << 32 | self._next_trace[0]
         return Trace(self, tid)
 
     def receive_token(self, token: Token) -> Trace:
@@ -212,19 +231,23 @@ class Tracer:
         self._vc[self.identity] = self._vc.get(self.identity, 0) + 1
 
     def _record(self, trace_id: int, action: Action) -> None:
+        self._record_many(trace_id, (action,))
+
+    def _record_many(self, trace_id: int, actions) -> None:
         with self._lock:
-            self._tick_locked()
-            vc = dict(self._vc)
-            self._emit(
-                {
-                    "type": "action",
-                    "identity": self.identity,
-                    "trace_id": trace_id,
-                    "action": action.name,
-                    "body": action.to_fields(),
-                    "vc": vc,
-                }
-            )
+            for action in actions:
+                self._tick_locked()
+                vc = dict(self._vc)
+                self._emit(
+                    {
+                        "type": "action",
+                        "identity": self.identity,
+                        "trace_id": trace_id,
+                        "action": action.name,
+                        "body": action.to_fields(),
+                        "vc": vc,
+                    }
+                )
 
     def _generate_token(self, trace_id: int) -> Token:
         with self._lock:

@@ -7,6 +7,7 @@ asserted directly.  Boot order mirrors cmd/* (coordinator, then workers,
 then clients; SURVEY.md section 3.5).
 """
 
+import contextlib
 import queue
 import threading
 import time
@@ -260,8 +261,10 @@ def test_failed_mine_does_not_leak_task_entry():
         s.workers[0].shutdown()
         client = s.new_client("client1")
         client.mine(b"\x69\x6a", 2)  # all workers dead -> Mine errors
-        with pytest.raises(queue.Empty):
-            client.notify_queue.get(timeout=2.0)
+        # the failure surfaces as an error result (VERDICT r1 item 6),
+        # not a silent drop that would leave the client blocked forever
+        r = client.notify_queue.get(timeout=10.0)
+        assert r.secret is None and r.error is not None
         deadline = time.time() + 5
         while s.coordinator.handler._tasks and time.time() < deadline:
             time.sleep(0.05)
@@ -489,3 +492,109 @@ def test_trace_tokens_cross_all_nodes(stack1):
     worker_tids = {e["trace_id"] for e in stack1.sinks["worker1"].events
                    if e["type"] == "action"}
     assert tid in coord_tids and tid in worker_tids
+
+
+def test_superseded_miner_exits_silently():
+    """A repeat Mine for a key whose previous round is still running must
+    cancel the zombie miner WITHOUT it emitting nil ACKs — those would be
+    routed into the new round's coordinator queue (keyed (nonce, ntz)) and
+    either trip the first-message protocol check or drain its ack ledger
+    early (ADVICE r1: worker task-table overwrite)."""
+    import queue as q
+
+    from distpow_tpu.backends import PythonBackend
+    from distpow_tpu.nodes.worker import WorkerRPCHandler
+    from distpow_tpu.runtime.tracing import MemorySink, Tracer, encode_token
+
+    tracer = Tracer("workerY", MemorySink())
+    rq: "q.Queue" = q.Queue()
+    h = WorkerRPCHandler(tracer, rq, PythonBackend())
+    token = encode_token(tracer.create_trace().generate_token())
+
+    # round 1: difficulty 10 on the python backend never finishes on its own
+    h.Mine({"nonce": [7, 7], "num_trailing_zeros": 10, "worker_byte": 0,
+            "worker_bits": 0, "token": token})
+    time.sleep(0.2)
+    # round 2: same key replaces round 1; its zombie must exit silently
+    h.Mine({"nonce": [7, 7], "num_trailing_zeros": 10, "worker_byte": 0,
+            "worker_bits": 0, "token": token})
+    time.sleep(0.5)
+    assert rq.empty(), "superseded miner leaked a message into the queue"
+
+    # the NEW round still works: a cache install stops it and it delivers
+    secret = b"\x12\x34"
+    h.result_cache.add(b"\x07\x07", 10, secret, None)
+    res = rq.get(timeout=15)
+    assert bytes(res["secret"]) == secret
+    h.Found({"nonce": [7, 7], "num_trailing_zeros": 10, "worker_byte": 0,
+             "secret": list(secret), "token": token})
+    ack = rq.get(timeout=5)
+    assert ack["secret"] is None
+    # and nothing further arrives from either round
+    time.sleep(0.3)
+    assert rq.empty()
+
+
+def test_coordinator_restart_mid_mine(tmp_path):
+    """Fault injection (VERDICT r1 items 5+6): the coordinator dies while
+    a worker is mining and comes back on the same ports.  The client must
+    OBSERVE the failure (error result, not a silent hang), the worker's
+    forwarder must re-dial and deliver its result to the restarted
+    coordinator (journal-backed cache), and a client retry must complete."""
+    from distpow_tpu.nodes import Coordinator
+    from distpow_tpu.runtime.config import CoordinatorConfig
+
+    cache_file = str(tmp_path / "coord_cache.jsonl")
+    s = Stack(1, coord_cache_file=cache_file)
+    try:
+        client = s.new_client("client1")
+        nonce = b"\x77\x78"
+        # difficulty 5 ~= 1M candidates on the python backend: seconds of
+        # mining, plenty of window to kill the coordinator mid-search
+        client.mine(nonce, 5)
+        time.sleep(0.6)  # fan-out done, worker mining
+
+        old_client_addr = s.coordinator.client_addr
+        old_worker_addr = s.coordinator.worker_addr
+        worker_addrs = [w.bound_addr for w in s.workers]
+        s.coordinator.shutdown()
+
+        # the in-flight Mine must surface as an error result
+        r = client.notify_queue.get(timeout=30)
+        assert r.error is not None and r.secret is None
+
+        # restart on the same ports (create_server sets SO_REUSEADDR);
+        # retry briefly — the worker's re-dial loop targeting this very
+        # port can transiently occupy it via a Linux self-connect
+        for attempt in range(40):
+            try:
+                s.coordinator = Coordinator(
+                    CoordinatorConfig(
+                        ClientAPIListenAddr=old_client_addr,
+                        WorkerAPIListenAddr=old_worker_addr,
+                        Workers=worker_addrs,
+                        CacheFile=cache_file,
+                    ),
+                    sink=s.sinks["coordinator"],
+                )
+                s.coordinator.initialize_rpcs()
+                break
+            except OSError:
+                # a half-bound server (first listener ok, second raced)
+                # must release its port before the retry
+                with contextlib.suppress(Exception):
+                    s.coordinator.shutdown()
+                if attempt == 39:
+                    raise
+                time.sleep(0.25)
+
+        # the worker finishes its (never-cancelled) search and the
+        # forwarder re-delivers to the restarted coordinator, landing the
+        # secret in its journal-backed cache; the retried request then
+        # completes (usually as a pure cache hit)
+        client2 = s.new_client("client1-retry")
+        res = mine_and_wait(client2, nonce, 5, timeout=120)
+        assert res.error is None
+        assert puzzle.check_secret(nonce, res.secret, 5)
+    finally:
+        s.close()

