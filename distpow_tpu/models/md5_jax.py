@@ -84,8 +84,18 @@ def md5_compress(state, words: Sequence):
             g = (7 * i) % 16
         m = words[g]
         if not hasattr(m, "dtype"):
-            m = jnp.uint32(m)
-        f = f + a + jnp.uint32(MD5_K[i]) + m
+            # compile-time constant word: fold the round constant in now
+            f = f + a + jnp.uint32((MD5_K[i] + int(m)) & 0xFFFFFFFF)
+        elif m.ndim == 0:
+            # runtime scalar word (the dynamic serving regime's base-word
+            # operands): group (K + m) so it is ONE scalar add hoisted
+            # out of the batch dimension instead of two scalar-vector
+            # adds — XLA does not reassociate this on its own, and the
+            # ungrouped form costs the dynamic regime ~1 vector op in
+            # each constant-word round vs the static regime
+            f = f + a + (jnp.uint32(MD5_K[i]) + m)
+        else:
+            f = f + a + jnp.uint32(MD5_K[i]) + m
         a, d, c = d, c, b
         b = b + _rotl(f, MD5_S[i])
     return (a0 + a, b0 + b, c0 + c, d0 + d)

@@ -86,20 +86,22 @@ def _round(st, k_i, w_i):
     a, b, c, d, e, f, g, h = st
     S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
     ch = (e & f) ^ (~e & g)
-    t1 = h + S1 + ch + k_i + w_i
+    # (k + w) grouped: for constant/scalar message words this is a
+    # scalar-unit add hoisted out of the batch dimension (XLA does not
+    # reassociate integer adds on its own); for batch words the op count
+    # is unchanged
+    t1 = h + S1 + ch + (k_i + w_i)
     S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
     maj = (a & b) ^ (a & c) ^ (b & c)
     return (t1 + S0 + maj, a, b, c, d + t1, e, f, g)
 
 
-@jax.jit
-def _sha256_compress_jit(state, words):
-    # Rounds 0-15 run unrolled on the RAW words — constant message words
-    # stay scalars XLA folds.  Rounds 16-63 run in a fori_loop carrying a
-    # rolling 16-word schedule WINDOW (a tuple, so it lives in
-    # registers/VMEM).  Never materialize the classic (64, batch)
-    # schedule array: its per-round scatter/gather traffic made the batch
-    # path ~100x slower than MD5 on TPU instead of the algorithmic ~2x.
+def _compress_loop(state, words):
+    """fori_loop form: rounds 0-15 unrolled on the RAW words (constant
+    message words stay scalars XLA folds); rounds 16-63 carry a rolling
+    16-word schedule WINDOW.  Compiles in ~1s everywhere — but on TPU
+    the window (16 batch-shaped arrays re-tupled per iteration) costs
+    real HBM traffic, so the serving path prefers the unrolled form."""
     ws = [_u32(m) for m in words]
     shape = jnp.broadcast_shapes(*(jnp.shape(w) for w in ws))
     st = tuple(_u32(s) for s in state)
@@ -124,6 +126,37 @@ def _sha256_compress_jit(state, words):
         unroll=4,
     )
     return tuple(_u32(s0) + s for s0, s in zip(state, st))
+
+
+def _compress_unrolled(state, words):
+    """Fully unrolled form: the message schedule is a plain Python list,
+    so schedule entries fed only by constant words stay SCALARS through
+    the recursion and every value flows register-to-register in one
+    fused graph — no rolling-window copies.  Measured 4.2x faster than
+    the loop form on TPU v5e (1,360 vs 322 MH/s serving-shape batch,
+    BENCH_r02) at ~13s compile."""
+    w = [_u32(m) for m in words]
+    for i in range(16, 64):
+        w15, w7, w2 = w[i - 15], w[i - 7], w[i - 2]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+        w.append(w[i - 16] + s0 + w7 + s1)
+    st = tuple(_u32(s) for s in state)
+    for i in range(64):
+        st = _round(st, jnp.uint32(SHA256_K[i]), w[i])
+    return tuple(_u32(s0) + s for s0, s in zip(state, st))
+
+
+@jax.jit
+def _sha256_compress_jit(state, words):
+    # Platform-keyed compilation strategy (the trace runs once per
+    # backend): XLA:CPU's codegen blows up exponentially on the unrolled
+    # 64-round graph (observed past ~56 rounds), while XLA:TPU compiles
+    # it in ~13s and runs it 4.2x faster than the loop form — the
+    # fori_loop's rolling window is HBM-traffic-bound on TPU.
+    if jax.default_backend() == "cpu":
+        return _compress_loop(state, words)
+    return _compress_unrolled(state, words)
 
 
 def sha256_digest_words(blocks: Sequence[Sequence]) -> Tuple:

@@ -44,10 +44,16 @@ from ..models.md5_jax import MD5_K, MD5_S
 from ..models.registry import get_hash_model
 from .difficulty import nibble_masks
 from .packing import build_tail_spec
-from .search_step import SENTINEL
+from .search_step import SENTINEL, _check_launch, mask_words_for
 
 LANES = 128
-DEFAULT_SUBLANES = 256  # (256, 128) tile = 32768 candidates per grid step
+# (64, 128) tile x 128 inner fori_loop iterations per grid step: the
+# tile height bounds live registers through the unrolled round chain
+# (taller tiles spill — 256 sublanes measured ~25% slower), the inner
+# loop amortizes per-grid-step fixed cost (TPU v5e sweep, BENCH_r02:
+# 9.95 GH/s at (64, 128) vs 2.34 GH/s for round 1's flat (256,) grid)
+DEFAULT_SUBLANES = 64
+DEFAULT_INNER = 128
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 
 
@@ -55,11 +61,30 @@ def _rotl(x, s: int):
     return (x << s) | (x >> (32 - s))
 
 
-def _md5_tile(words, init):
-    """Unrolled 64-round MD5 on a tile; ``words[g]`` is an array or scalar."""
+def _md5_tile(words, init, mask_words: int = 4):
+    """Unrolled 64-round MD5 on a tile; ``words[g]`` is an array or scalar.
+
+    ``mask_words`` is how many TRAILING digest words the difficulty check
+    reads (ops/search_step.py mask_words_for).  Trailing zero nibbles
+    live in the last digest words, so for low difficulties only ``d``
+    (and then ``c``, ...) matter; the rotation schedule means the last
+    rounds' expensive f/rotl chains feed only the leading digest words —
+    final ``b`` is produced by round 63, ``c`` by 62, ``a`` by 61 via the
+    ``a,d,c = d,c,b`` shuffle — so those rounds are skipped entirely when
+    their outputs are dead.  This is the same dead-code elimination XLA
+    performs on the fused step (where the unused digest words are simply
+    never consumed); Mosaic cannot see through the runtime mask operands,
+    so the bucket is a compile key here too.
+    """
     a0, b0, c0, d0 = init
     a, b, c, d = a0, b0, c0, d0
-    for i in range(64):
+    # final digest word <- round whose new-b produces it: b <- 63,
+    # c <- 62, d <- 61, a <- 60.  Keeping the last mask_words digest
+    # words therefore needs rounds through 61 (mw=1), 62 (mw=2), or all
+    # 64 (mw>=3, since final b is round 63's output).
+    mw = max(1, min(4, mask_words))
+    last_round = 64 - max(0, 3 - mw)
+    for i in range(last_round):
         if i < 16:
             f = (b & c) | (~b & d)
             g = i
@@ -73,12 +98,28 @@ def _md5_tile(words, init):
             f = c ^ (b | ~d)
             g = (7 * i) % 16
         m = words[g]
-        if not hasattr(m, "dtype"):
-            m = jnp.uint32(m)
-        f = f + a + jnp.uint32(MD5_K[i]) + m
+        if hasattr(m, "ndim") and m.ndim == 0 or not hasattr(m, "dtype"):
+            # constant message word: fold the round constant into it on
+            # the scalar unit — one scalar-vector add instead of two.
+            # XLA's static regime gets this from compile-time constant
+            # folding; here the fold is a cheap scalar op per round.
+            f = f + a + (jnp.uint32(MD5_K[i]) + jnp.uint32(m))
+        else:
+            f = f + a + jnp.uint32(MD5_K[i]) + m
         a, d, c = d, c, b
         b = b + _rotl(f, MD5_S[i])
-    return (a0 + a, b0 + b, c0 + c, d0 + d)
+    # un-shuffle the skipped rounds: after round r the registers hold the
+    # values that WOULD rotate into place; digest word j (a=0,b=1,c=2,d=3)
+    # is live iff j >= 4 - mask_words
+    regs = [a, b, c, d]
+    for _ in range(64 - last_round):
+        # each skipped round performs a,d,c = d,c,b with a new b nobody
+        # alive consumes; inverse-rotate the register file instead
+        regs = [regs[3], None, regs[1], regs[2]]
+    out = []
+    for j, (r, r0) in enumerate(zip(regs, (a0, b0, c0, d0))):
+        out.append(None if j < 4 - mw else r0 + r)
+    return tuple(out)
 
 
 @functools.lru_cache(maxsize=None)
@@ -89,14 +130,29 @@ def _dyn_pallas_step(
     grid: int,
     sublanes: int,
     interpret: bool,
+    inner: int = 1,
+    mask_words: int = 4,
 ):
     """Layout-keyed pallas program.
 
-    Returned jitted fn: ``(chunk0, init[4], base[16], masks[4],
+    Returned jitted fn: ``(chunk0, init[4], base[16], masks[mask_words],
     part[2]=(tb_lo, log_tbc)) -> uint32`` (flat first-hit index or
     SENTINEL).
+
+    Each grid step evaluates ``inner`` consecutive (sublanes, 128) tiles
+    in an on-device ``fori_loop``.  The split matters: sublanes bounds
+    the live register set of the unrolled 64-round chain (too tall
+    spills to VMEM), while inner amortizes the per-grid-step fixed cost
+    (index iota, bookkeeping, the cross-lane min) — see DEFAULT_SUBLANES
+    for the measured TPU v5e sweep.
+
+    ``mask_words`` (the trailing-digest-word bucket of
+    ops.search_step.mask_words_for) is a compile key: the final MD5
+    rounds whose outputs only feed dead digest words are skipped in
+    ``_md5_tile``, matching the DCE XLA applies to the fused step.
     """
     tile = sublanes * LANES
+    mw = max(1, min(4, mask_words))
 
     def kernel(chunk0_ref, init_ref, base_ref, masks_ref, part_ref, out_ref):
         i = pl.program_id(0)
@@ -105,44 +161,62 @@ def _dyn_pallas_step(
         log_tbc = part_ref[1]
         row = jax.lax.broadcasted_iota(jnp.uint32, (sublanes, LANES), 0)
         col = jax.lax.broadcasted_iota(jnp.uint32, (sublanes, LANES), 1)
-        f = (
-            jnp.uint32(i) * jnp.uint32(tile)
+        f0 = (
+            jnp.uint32(i) * jnp.uint32(tile * inner)
             + row * jnp.uint32(LANES)
             + col
         )
-        chunk = chunk0 + (f >> log_tbc)
-        tb = tb_lo + (f & ((jnp.uint32(1) << log_tbc) - jnp.uint32(1)))
+        init = tuple(init_ref[j] for j in range(4))
+        consts = [base_ref[w] for w in range(16)]
 
-        words = [base_ref[w] for w in range(16)]
-        words[tb_word] = words[tb_word] | (tb << tb_shift_in_word)
-        for j, (w_i, s_i) in enumerate(chunk_word_shifts):
-            byte_j = (chunk >> jnp.uint32(8 * j)) & jnp.uint32(0xFF)
-            words[w_i] = words[w_i] | (byte_j << s_i)
+        def tile_candidates(f):
+            """Elementwise (sublanes, LANES) array of int32 flat indices:
+            the candidate's own index where it hits, _I32_MISS where not.
+            Kept elementwise so the inner loop accumulates with ONE
+            vector minimum per tile; the expensive cross-lane min runs
+            once per grid step, not once per tile.  (Mosaic has no
+            unsigned reductions; flat indices are far below 2^31, so the
+            int32 domain with int32-max as miss marker is exact.)"""
+            chunk = chunk0 + (f >> log_tbc)
+            tb = tb_lo + (f & ((jnp.uint32(1) << log_tbc) - jnp.uint32(1)))
+            words = list(consts)
+            words[tb_word] = words[tb_word] | (tb << tb_shift_in_word)
+            for j, (w_i, s_i) in enumerate(chunk_word_shifts):
+                byte_j = (chunk >> jnp.uint32(8 * j)) & jnp.uint32(0xFF)
+                words[w_i] = words[w_i] | (byte_j << s_i)
 
-        a, b, c, d = _md5_tile(
-            words, (init_ref[0], init_ref[1], init_ref[2], init_ref[3])
-        )
-        acc = (
-            (a & masks_ref[0]) | (b & masks_ref[1])
-            | (c & masks_ref[2]) | (d & masks_ref[3])
-        )
-        hit = acc == jnp.uint32(0)
-        # Mosaic has no unsigned-integer reductions; flat indices are far
-        # below 2^31, so reduce in int32 with int32-max as the in-kernel
-        # miss marker and translate back to SENTINEL outside.
-        tile_min = jnp.min(
-            jnp.where(hit, f.astype(jnp.int32), jnp.int32(_I32_MISS))
-        )
+            state = _md5_tile(words, init, mw)
+            acc = state[4 - mw] & masks_ref[0]
+            for j in range(1, mw):
+                acc = acc | (state[4 - mw + j] & masks_ref[j])
+            hit = acc == jnp.uint32(0)
+            return jnp.where(hit, f.astype(jnp.int32), jnp.int32(_I32_MISS))
+
+        if inner == 1:
+            m = jnp.min(tile_candidates(f0))
+        else:
+            best = jax.lax.fori_loop(
+                0,
+                inner,
+                lambda j, best: jnp.minimum(
+                    best,
+                    tile_candidates(
+                        f0 + j.astype(jnp.uint32) * jnp.uint32(tile)
+                    ),
+                ),
+                jnp.full((sublanes, LANES), _I32_MISS, jnp.int32),
+            )
+            m = jnp.min(best)
 
         # TPU grid steps run sequentially on the core, so a single SMEM
         # cell accumulates the global min across the grid.
         @pl.when(i == 0)
         def _init():
-            out_ref[0, 0] = tile_min
+            out_ref[0, 0] = m
 
         @pl.when(i > 0)
         def _acc():
-            out_ref[0, 0] = jnp.minimum(out_ref[0, 0], tile_min)
+            out_ref[0, 0] = jnp.minimum(out_ref[0, 0], m)
 
     call = pl.pallas_call(
         kernel,
@@ -177,13 +251,21 @@ def build_pallas_search_step(
     extra_const_chunk: bytes = b"",
     sublanes: int = DEFAULT_SUBLANES,
     interpret: bool = False,
+    launch_steps: int = 1,
+    inner: int = DEFAULT_INNER,
 ) -> Callable:
     """Build ``step(chunk0) -> uint32`` backed by the Pallas kernel.
 
-    Same contract as ``ops.search_step.build_search_step``.  Requires
-    ``tb_count`` to be a power of two and the MD5 model with a single-block
-    tail (the overwhelmingly common configuration); callers fall back to
-    the XLA path otherwise.
+    Same contract as ``ops.search_step.build_search_step``, including the
+    ``launch_steps`` multiplier: one dispatch covers ``launch_steps *
+    chunks_per_step * tb_count`` candidates.  Where the XLA path amortizes
+    the per-dispatch round trip with an on-device ``fori_loop``, the
+    kernel simply extends its sequential TPU grid — the flat index
+    already spans ``program_id * tile``, so a larger grid IS the
+    multi-sub-batch launch, with no extra machinery.  Requires
+    ``tb_count`` to be a power of two and the MD5 model with a
+    single-block tail (the overwhelmingly common configuration); callers
+    fall back to the XLA path otherwise.
     """
     model = get_hash_model(model_name)
     if model.name != "md5":
@@ -200,15 +282,26 @@ def build_pallas_search_step(
     tile = sublanes * LANES
     if batch % tile:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
-    grid = batch // tile
+    _check_launch(batch, launch_steps)
+    tiles = batch * launch_steps // tile
+    # the inner fori_loop length must divide the tile count; shrink to fit
+    inner = max(1, inner)
+    while tiles % inner:
+        inner //= 2
+    grid = tiles // inner
 
+    mw = mask_words_for(difficulty, model)
     _, tb_w, tb_s = spec.tb_loc
     chunk_ws = tuple((w, s) for _, w, s in spec.chunk_locs)
-    dyn = _dyn_pallas_step(tb_w, tb_s, chunk_ws, grid, sublanes, interpret)
+    dyn = _dyn_pallas_step(
+        tb_w, tb_s, chunk_ws, grid, sublanes, interpret, inner, mw
+    )
 
     init = jnp.asarray(spec.init_state, jnp.uint32)
     base = jnp.asarray(spec.base_words[0], jnp.uint32)
-    masks_arr = jnp.asarray(masks, jnp.uint32)
+    # only the significant trailing mask words enter the kernel (their
+    # count is the compile key, same discipline as step_operands)
+    masks_arr = jnp.asarray(masks[model.digest_words - mw:], jnp.uint32)
     part = jnp.asarray([tb_lo, tb_count.bit_length() - 1], jnp.uint32)
 
     def step(chunk0):
@@ -229,8 +322,11 @@ def cached_pallas_search_step(
     extra_const_chunk: bytes = b"",
     sublanes: int = DEFAULT_SUBLANES,
     interpret: bool = False,
+    launch_steps: int = 1,
+    inner: int = DEFAULT_INNER,
 ):
     return build_pallas_search_step(
         nonce, width, difficulty, tb_lo, tb_count, chunks_per_step,
-        model_name, extra_const_chunk, sublanes, interpret,
+        model_name, extra_const_chunk, sublanes, interpret, launch_steps,
+        inner,
     )

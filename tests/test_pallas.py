@@ -63,3 +63,42 @@ def test_pallas_backend_falls_back_for_long_nonce():
     secret = backend.search(nonce, 1, list(range(256)))
     assert secret is not None
     assert puzzle.check_secret(nonce, secret, 1)
+
+
+def test_pallas_launch_steps_extends_grid():
+    # k sub-batches in one dispatch == k sequential dispatches' minimum
+    nonce = b"\x11\x12\x13"
+    step_k = build_pallas_search_step(
+        nonce, 1, 2, 0, 256, 4, sublanes=8, interpret=True, launch_steps=3
+    )
+    step_1 = build_search_step(nonce, 1, 2, 0, 256, 4, MD5)
+    for c0 in (1, 64):
+        got = int(step_k(jnp.uint32(c0)))
+        best = SENTINEL
+        for i in range(3):
+            f = int(step_1(jnp.uint32(c0 + 4 * i)))
+            if f != SENTINEL:
+                best = min(best, f + i * 4 * 256)
+        assert got == best
+
+
+def test_pallas_launch_bound_enforced():
+    with pytest.raises(ValueError, match="2\\^31"):
+        build_pallas_search_step(
+            b"\x01", 4, 2, 0, 256, 1 << 16, sublanes=8, interpret=True,
+            launch_steps=1 << 8,
+        )
+
+
+def test_pallas_mask_word_buckets_match_xla():
+    # difficulties spanning all four trailing-word buckets exercise the
+    # skipped-final-rounds DCE (mw=1 skips rounds 62-63, mw=2 skips 63)
+    nonce = b"\x21\x22\x23"
+    for d in (1, 2, 8, 9, 16, 17, 25):
+        step_p = build_pallas_search_step(
+            nonce, 2, d, 0, 256, 64, sublanes=8, interpret=True, inner=4
+        )
+        step_x = build_search_step(nonce, 2, d, 0, 256, 64, MD5)
+        for c0 in (256, 4096):
+            assert int(step_p(jnp.uint32(c0))) == int(step_x(jnp.uint32(c0))), \
+                f"divergence at difficulty {d} chunk0 {c0}"
