@@ -47,13 +47,14 @@ from .packing import build_tail_spec
 from .search_step import SENTINEL, _check_launch, mask_words_for
 
 LANES = 128
-# (64, 128) tile x 128 inner fori_loop iterations per grid step: the
+# (64, 128) tile x 512 inner fori_loop iterations per grid step: the
 # tile height bounds live registers through the unrolled round chain
 # (taller tiles spill — 256 sublanes measured ~25% slower), the inner
 # loop amortizes per-grid-step fixed cost (TPU v5e sweep, BENCH_r02:
-# 9.95 GH/s at (64, 128) vs 2.34 GH/s for round 1's flat (256,) grid)
+# ~10.0 GH/s at (64, 512) vs 2.34 GH/s for round 1's flat (256,) grid;
+# inner auto-shrinks to divide smaller launches)
 DEFAULT_SUBLANES = 64
-DEFAULT_INNER = 128
+DEFAULT_INNER = 512
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 
 

@@ -102,10 +102,13 @@ def build_search_step(
 def _check_launch(batch: int, launch_steps: int) -> None:
     if launch_steps < 1:
         raise ValueError(f"launch_steps must be >= 1, got {launch_steps}")
-    if batch * launch_steps > 1 << 31:
+    # strictly below 2^31: at exactly 2^31 the last flat index equals
+    # the Pallas kernel's int32 miss marker (0x7FFFFFFF), making a hit
+    # at that index indistinguishable from a miss
+    if batch * launch_steps >= 1 << 31:
         raise ValueError(
-            f"launch covers {batch * launch_steps} candidates; flat uint32 "
-            f"indices require <= 2^31 per dispatch"
+            f"launch covers {batch * launch_steps} candidates; flat "
+            f"indices require < 2^31 per dispatch"
         )
 
 

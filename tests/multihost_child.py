@@ -1,0 +1,47 @@
+"""Child process for the 2-process jax.distributed multi-host test.
+
+Usage: python multihost_child.py <process_id> <coordinator_port>
+
+Each of the two processes owns 4 virtual CPU devices; together they form
+one 8-device global mesh.  The mesh solve's ``lax.pmin`` found-index
+collective must cross the process boundary for either process to learn
+the result (the winning candidate is pinned to the upper thread-byte
+half, i.e. process 1's devices).  Run by tests/test_multihost.py.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+# the container's sitecustomize has already imported jax against the
+# axon/TPU backend, so the platform flip must go through jax.config
+# (same pattern as tests/conftest.py); XLA_FLAGS is still read lazily
+# at backend initialization
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+assert len(jax.local_devices()) == 4, jax.local_devices()
+assert len(jax.devices()) == 8, jax.devices()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distpow_tpu.models import puzzle  # noqa: E402
+from distpow_tpu.parallel.mesh_search import make_mesh, search_mesh  # noqa: E402
+
+# nonce chosen so the FIRST solution in enumeration order is
+# (tb=214, chunk=empty->width probe) — tb 214 lives on global device
+# 214 // 32 = 6, owned by process 1 (tests/test_multihost.py verified
+# the oracle offline)
+NONCE = bytes.fromhex("045a")
+res = search_mesh(NONCE, 2, list(range(256)), mesh=make_mesh(jax.devices()),
+                  batch_size=1 << 12)
+assert res is not None
+assert puzzle.check_secret(NONCE, res.secret, 2)
+print(f"RESULT pid={pid} secret={res.secret.hex()} tb={res.thread_byte}",
+      flush=True)
