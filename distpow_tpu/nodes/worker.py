@@ -82,6 +82,21 @@ def _key(params) -> TaskKey:
             int(params["worker_byte"]))
 
 
+def _rid_order(rid: str) -> str:
+    """Round-id ordering key, robust to the id-format width change.
+
+    Epoch-prefixed ids (nodes/coordinator.py new_round_id) are 24 hex
+    chars; ids minted by the pre-epoch format (or by a coordinator
+    running without a CacheFile before the epoch field existed) are the
+    bare 16-char time_ns — exactly an epoch-0 id without its prefix.
+    Left-padding with zeros makes the two formats compare correctly
+    during a mixed-format window (worker outlives a coordinator
+    upgrade); plain string comparison would order EVERY new-format id
+    before every old-format one.
+    """
+    return rid.rjust(24, "0")
+
+
 class TaskRound:
     """One Mine round's cancellation state.
 
@@ -162,7 +177,7 @@ class WorkerRPCHandler:
             if rid is None or cur.round_id is None or cur.round_id == rid:
                 del self._tasks[key]
                 return cur
-            if rid > cur.round_id:
+            if _rid_order(rid) > _rid_order(cur.round_id):
                 del self._tasks[key]
                 cur.superseded = True
                 cur.ev.set()

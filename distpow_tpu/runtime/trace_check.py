@@ -177,7 +177,7 @@ def _check_worker(trace_id: int, identity: str, seq: List[Event],
     per_byte: Dict[object, List[Event]] = {}
     for e in seq:
         if e.action in WORKER_ACTIONS:
-            per_byte.setdefault(e.body.get("worker_byte"), []).append(e)
+            per_byte.setdefault(e.body.get("WorkerByte"), []).append(e)
     for byte, evs in per_byte.items():
         names = [e.action for e in evs]
         if names and names[0] != "WorkerMine" and "WorkerMine" in names:

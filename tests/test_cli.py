@@ -56,6 +56,20 @@ def test_stock_configs_load():
                             ClientConfig).ClientID == "client1"
 
 
+def test_difficulty_bits_translation():
+    """--difficulty-bits N == --difficulty N/4 (SURVEY.md section 7's
+    unit mapping: BASELINE configs speak bits, the protocol's
+    numTrailingZeros counts nibbles, worker.go:246-256)."""
+    from distpow_tpu.cli.client import difficulty_nibbles
+
+    assert difficulty_nibbles(None, 32) == 8  # --difficulty-bits 32
+    assert difficulty_nibbles(8, None) == 8   # == --difficulty 8
+    assert difficulty_nibbles(None, None) == 5  # default
+    assert difficulty_nibbles(None, 4) == 1
+    with pytest.raises(ValueError):
+        difficulty_nibbles(None, 30)  # not a whole number of nibbles
+
+
 @pytest.mark.slow
 def test_multiprocess_demo_scenario(tmp_path):
     """Boot tracing server + coordinator + 2 workers + demo client as

@@ -213,7 +213,7 @@ def test_reassign_dead_worker_at_fanout():
         coord = s.action_names("coordinator")
         # 2 fan-out attempts + 1 reassignment of the dead worker's shard
         assert coord.count("CoordinatorWorkerMine") == 3
-        mines = [a[2]["worker_byte"] for a in s.sinks["coordinator"].actions()
+        mines = [a[2]["WorkerByte"] for a in s.sinks["coordinator"].actions()
                  if a[1] == "CoordinatorWorkerMine"]
         assert sorted(mines) == [0, 1, 1]  # shard 1 re-issued
     finally:
@@ -246,7 +246,7 @@ def test_reassign_hung_worker_detected():
         client = s.new_client("client1")
         res = mine_and_wait(client, b"\x67\x68", 2, timeout=30)
         assert puzzle.check_secret(res.nonce, res.secret, 2)
-        mines = [a[2]["worker_byte"] for a in s.sinks["coordinator"].actions()
+        mines = [a[2]["WorkerByte"] for a in s.sinks["coordinator"].actions()
                  if a[1] == "CoordinatorWorkerMine"]
         assert sorted(mines) == [0, 1, 1]
     finally:
@@ -533,6 +533,62 @@ def test_superseded_miner_exits_silently():
     # and nothing further arrives from either round
     time.sleep(0.3)
     assert rq.empty()
+
+
+def test_round_ids_survive_backward_clock_restart(tmp_path, monkeypatch):
+    """A coordinator restart under a spoofed BACKWARD clock step (larger
+    than the downtime) must still order new round ids after old ones —
+    the persisted restart epoch, not the wall clock, carries the ordering
+    (VERDICT r2 weak #6) — and the worker's zombie-vs-live resolution
+    (worker.py _task_take) must therefore pop the zombie, not the live
+    round."""
+    from distpow_tpu.nodes import coordinator as coord_mod
+    from distpow_tpu.nodes.worker import TaskRound, WorkerRPCHandler
+    from distpow_tpu.runtime.tracing import Tracer
+
+    epoch_path = str(tmp_path / "cache.jsonl.epoch")
+
+    # boot 1, normal clock: a round goes out and its cancel is lost
+    e1 = coord_mod.load_restart_epoch(epoch_path)
+    rid_zombie = coord_mod.new_round_id(e1)
+
+    # boot 2: the clock has stepped WAY back (before boot) and the fresh
+    # process has no in-memory monotonic floor; the persisted epoch must
+    # still strictly increase
+    monkeypatch.setattr(coord_mod.time, "time", lambda: 1.0)
+    monkeypatch.setattr(coord_mod.time, "time_ns", lambda: 1_000)
+    monkeypatch.setattr(coord_mod, "_last_round_ns", [0])
+    e2 = coord_mod.load_restart_epoch(epoch_path)
+    assert e2 > e1
+    rid_live = coord_mod.new_round_id(e2)
+    assert rid_live > rid_zombie  # epoch dominates the backward clock
+
+    # worker side: a Found tagged with the NEW round id against a zombie
+    # entry from the old round pops + supersedes the zombie...
+    handler = WorkerRPCHandler(
+        Tracer("worker1", MemorySink()), queue.Queue(), backend=None
+    )
+    key = (b"\x01", 2)
+    zombie = TaskRound(rid_zombie)
+    handler._task_set(key, zombie)
+    assert handler._task_take(key, rid_live) is None
+    assert zombie.superseded and zombie.ev.is_set()
+    # ...while a stale Found tagged with the OLD id must not disturb the
+    # live round
+    live = TaskRound(rid_live)
+    handler._task_set(key, live)
+    assert handler._task_take(key, rid_zombie) is None
+    assert not live.superseded
+    assert handler._task_get(key) is live
+
+    # mixed-format window: a pre-epoch 16-char id (bare time_ns hex)
+    # held by a long-lived worker must order BELOW any epoch-prefixed id
+    # (worker.py _rid_order pads it as epoch 0)
+    old_format = f"{123_456_789_000:016x}"
+    legacy = TaskRound(old_format)
+    handler._task_set(key, legacy)
+    assert handler._task_take(key, rid_live) is None
+    assert legacy.superseded
 
 
 def test_coordinator_restart_mid_mine(tmp_path):

@@ -47,8 +47,9 @@ def test_trace_actions_and_vector_clocks():
     )
     acts = sink.actions(identity="client1")
     assert [a[1] for a in acts] == ["CoordinatorMine", "WorkerResult"]
-    assert acts[0][2]["nonce"] == [1, 2]
-    assert acts[1][2]["secret"] == [7]
+    # trace bodies carry the Go structs' CamelCase field names
+    assert acts[0][2]["Nonce"] == [1, 2]
+    assert acts[1][2]["Secret"] == [7]
     # vector clock strictly increases on the recording identity
     clocks = [e["vc"]["client1"] for e in sink.events if e["type"] == "action"]
     assert clocks == sorted(clocks) and len(set(clocks)) == len(clocks)
@@ -128,7 +129,7 @@ def test_cache_replace_on_higher_difficulty(traced_cache):
     assert names(sink) == ["CacheAdd", "CacheRemove", "CacheAdd", "CacheHit"]
     # the remove logs the OLD entry (coordinator.go:438-442)
     remove = sink.actions()[1][2]
-    assert remove["num_trailing_zeros"] == 3 and remove["secret"] == [0xAA]
+    assert remove["NumTrailingZeros"] == 3 and remove["Secret"] == [0xAA]
 
 
 def test_cache_replace_on_lexicographically_greater_secret(traced_cache):

@@ -74,9 +74,9 @@ def test_demo_scenario_trace_has_no_violations(tmp_path):
 def test_checker_flags_missing_cancel(tmp_path):
     log = tmp_path / "bad.log"
     log.write_text(
-        "[worker1] TraceID=7 WorkerMine nonce=[1], num_trailing_zeros=2, worker_byte=0\n"
-        "[worker1] TraceID=7 WorkerResult nonce=[1], num_trailing_zeros=2, "
-        "worker_byte=0, secret=[170]\n"
+        "[worker1] TraceID=7 WorkerMine Nonce=[1], NumTrailingZeros=2, WorkerByte=0\n"
+        "[worker1] TraceID=7 WorkerResult Nonce=[1], NumTrailingZeros=2, "
+        "WorkerByte=0, Secret=[170]\n"
     )
     violations = check_trace_log(str(log))
     assert any("WorkerResult without a following WorkerCancel" in v
@@ -86,10 +86,10 @@ def test_checker_flags_missing_cancel(tmp_path):
 def test_checker_flags_cancel_before_result(tmp_path):
     log = tmp_path / "bad.log"
     log.write_text(
-        "[worker1] TraceID=7 WorkerMine nonce=[1], num_trailing_zeros=2, worker_byte=0\n"
-        "[worker1] TraceID=7 WorkerCancel nonce=[1], num_trailing_zeros=2, worker_byte=0\n"
-        "[worker1] TraceID=7 WorkerResult nonce=[1], num_trailing_zeros=2, "
-        "worker_byte=0, secret=[170]\n"
+        "[worker1] TraceID=7 WorkerMine Nonce=[1], NumTrailingZeros=2, WorkerByte=0\n"
+        "[worker1] TraceID=7 WorkerCancel Nonce=[1], NumTrailingZeros=2, WorkerByte=0\n"
+        "[worker1] TraceID=7 WorkerResult Nonce=[1], NumTrailingZeros=2, "
+        "WorkerByte=0, Secret=[170]\n"
     )
     violations = check_trace_log(str(log))
     assert any("WorkerCancel before WorkerResult" in v for v in violations)
@@ -99,12 +99,12 @@ def test_checker_flags_cancel_before_result(tmp_path):
 def test_checker_flags_fanout_after_hit(tmp_path):
     log = tmp_path / "bad.log"
     log.write_text(
-        "[coordinator] TraceID=9 CoordinatorMine nonce=[1], num_trailing_zeros=2\n"
-        "[coordinator] TraceID=9 CacheHit nonce=[1], num_trailing_zeros=2, secret=[170]\n"
-        "[coordinator] TraceID=9 CoordinatorWorkerMine nonce=[1], "
-        "num_trailing_zeros=2, worker_byte=0\n"
-        "[coordinator] TraceID=9 CoordinatorSuccess nonce=[1], "
-        "num_trailing_zeros=2, secret=[170]\n"
+        "[coordinator] TraceID=9 CoordinatorMine Nonce=[1], NumTrailingZeros=2\n"
+        "[coordinator] TraceID=9 CacheHit Nonce=[1], NumTrailingZeros=2, Secret=[170]\n"
+        "[coordinator] TraceID=9 CoordinatorWorkerMine Nonce=[1], "
+        "NumTrailingZeros=2, WorkerByte=0\n"
+        "[coordinator] TraceID=9 CoordinatorSuccess Nonce=[1], "
+        "NumTrailingZeros=2, Secret=[170]\n"
     )
     violations = check_trace_log(str(log))
     assert any("fan-out after CacheHit" in v for v in violations)
@@ -113,10 +113,10 @@ def test_checker_flags_fanout_after_hit(tmp_path):
 def test_checker_flags_unpaired_cache_remove(tmp_path):
     log = tmp_path / "bad.log"
     log.write_text(
-        "[coordinator] TraceID=5 CoordinatorMine nonce=[1], num_trailing_zeros=2\n"
-        "[coordinator] TraceID=5 CacheRemove nonce=[1], num_trailing_zeros=1, secret=[9]\n"
-        "[coordinator] TraceID=5 CoordinatorSuccess nonce=[1], "
-        "num_trailing_zeros=2, secret=[170]\n"
+        "[coordinator] TraceID=5 CoordinatorMine Nonce=[1], NumTrailingZeros=2\n"
+        "[coordinator] TraceID=5 CacheRemove Nonce=[1], NumTrailingZeros=1, Secret=[9]\n"
+        "[coordinator] TraceID=5 CoordinatorSuccess Nonce=[1], "
+        "NumTrailingZeros=2, Secret=[170]\n"
     )
     violations = check_trace_log(str(log))
     assert any("CacheRemove" in v and "CacheAdd" in v for v in violations)
@@ -143,8 +143,8 @@ def test_cli_trace_check(tmp_path, capsys):
     assert main([str(out), str(shiviz)]) == 0
     bad = tmp_path / "bad.log"
     bad.write_text(
-        "[worker1] TraceID=7 WorkerMine nonce=[1], num_trailing_zeros=2, worker_byte=0\n"
-        "[worker1] TraceID=7 WorkerResult nonce=[1], num_trailing_zeros=2, "
-        "worker_byte=0, secret=[170]\n"
+        "[worker1] TraceID=7 WorkerMine Nonce=[1], NumTrailingZeros=2, WorkerByte=0\n"
+        "[worker1] TraceID=7 WorkerResult Nonce=[1], NumTrailingZeros=2, "
+        "WorkerByte=0, Secret=[170]\n"
     )
     assert main([str(bad)]) == 1

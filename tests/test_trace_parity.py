@@ -43,8 +43,8 @@ def _node_sequence(sink):
             continue
         b = e["body"]
         seq.append([e["trace_id"], e["action"],
-                    bytes(b["nonce"]).hex() if "nonce" in b else None,
-                    b.get("num_trailing_zeros")])
+                    bytes(b["Nonce"]).hex() if "Nonce" in b else None,
+                    b.get("NumTrailingZeros")])
     return seq
 
 
