@@ -197,6 +197,25 @@ def main() -> None:
     except Exception as exc:
         print(f"[bench] sha256 serving bench failed: {exc}", file=sys.stderr)
 
+    # SHA-256 Pallas kernel (round 3): explicit sublanes=8 tile geometry
+    # to dodge the register spills capping the XLA fusion at ~77% of the
+    # measured roofline (docs/KERNELS.md)
+    try:
+        from distpow_tpu.ops.md5_pallas import build_pallas_search_step as _bps
+
+        def sha_pallas_builder():
+            step = _bps(
+                nonce, 4, difficulty, 0, 256, chunks,
+                model_name="sha256", launch_steps=k_sha,
+            )
+            return step, chunks * 256 * k_sha
+
+        rates["sha256-pallas"] = device_rate(
+            sha_pallas_builder, f"sha256 pallas kernel, k={k_sha}"
+        )
+    except Exception as exc:
+        print(f"[bench] sha256 pallas bench failed: {exc}", file=sys.stderr)
+
     # Utilization vs a MEASURED VPU integer roofline (VERDICT r2 weak #4:
     # round 2's 7.7 Tops/s denominator was back-derived from the hash
     # rates themselves; this one is measured by a pure rotate-add chain
@@ -222,9 +241,10 @@ def main() -> None:
               f"= {100 * md5_best * MD5_OPS_PER_HASH / roofline:.0f}% "
               f"(at {MD5_OPS_PER_HASH} XLA-counted ops/hash)",
               file=sys.stderr)
-        if "sha256-serving" in rates:
-            sha_rate = rates["sha256-serving"]
-            print(f"[bench] VPU utilization (sha256 serving): "
+        sha_rates = {l: v for l, v in rates.items() if "sha" in l}
+        if sha_rates:
+            sha_rate = max(sha_rates.values())
+            print(f"[bench] VPU utilization (sha256 best path): "
                   f"{sha_rate * SHA256_OPS_PER_HASH / 1e12:.2f} Tops/s of "
                   f"{roofline / 1e12:.2f} Tops/s measured roofline "
                   f"= {100 * sha_rate * SHA256_OPS_PER_HASH / roofline:.0f}% "

@@ -13,9 +13,8 @@ from typing import Optional
 
 from ..models.registry import get_hash_model
 from ..ops.md5_pallas import (
-    DEFAULT_INNER,
-    DEFAULT_SUBLANES,
     LANES,
+    MODEL_GEOMETRY,
     cached_pallas_search_step,
 )
 from ..ops.search_step import cached_search_step
@@ -29,8 +28,8 @@ class PallasBackend:
         self,
         hash_model: str = "md5",
         batch_size: int = 1 << 20,
-        sublanes: int = DEFAULT_SUBLANES,
-        inner: int = DEFAULT_INNER,
+        sublanes: Optional[int] = None,
+        inner: Optional[int] = None,
         interpret: bool = False,
         max_launch: Optional[int] = None,
         **_,
@@ -39,8 +38,13 @@ class PallasBackend:
 
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
-        self.sublanes = sublanes
-        self.inner = inner
+        # per-model tuned tile geometry unless explicitly overridden
+        # (models without a tuned entry get md5's; the kernel builder
+        # rejects unimplemented models before the geometry matters)
+        default_geom = MODEL_GEOMETRY.get(self.model.name,
+                                          MODEL_GEOMETRY["md5"])
+        self.sublanes = sublanes if sublanes is not None else default_geom[0]
+        self.inner = inner if inner is not None else default_geom[1]
         self.interpret = interpret
         self.max_launch = max_launch or DEFAULT_LAUNCH_CANDIDATES
 

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the fused MD5 proof-of-work search step.
+"""Pallas TPU kernels for the fused proof-of-work search step (MD5 + SHA-256).
 
 The hot op of the framework (SURVEY.md section 7 layer 4, the "north
 star"): one kernel launch evaluates a dense tile grid of candidates —
@@ -28,12 +28,26 @@ The same computation expressed in plain jnp (ops/search_step.py) leaves
 fusion decisions to XLA; this kernel pins them.  Both paths share the
 packing template and difficulty masks, and tests/test_pallas.py checks
 them equal in interpret mode; bench.py compares them on hardware.
+
+SHA-256 shares the whole scaffold (grid, SMEM operands, index
+decomposition, min accumulation) with a different tile function and tile
+geometry.  Unlike MD5, where the kernel only matched XLA, SHA-256 is
+where explicit geometry should PAY: the unrolled XLA step compiles to
+one loop fusion but runs at ~77% of the measured VPU roofline
+(BENCH round 3) — consistent with register spills from the ~24-value
+live set (16-word schedule window + 8 working vars).  The kernel pins
+sublanes=8 so each live value is a single (8, 128) vreg.  The tile
+function uses the functional A/E form (a_r/e_r sequences instead of the
+8-var shuffle), which makes the difficulty-bucket dead-code elimination
+exact: digest word j reads A[63-j] (j<4) or E[67-j] (j>=4), so for the
+dominant mask_words=1 bucket the A-chain stops at round 56, the E-chain
+at 60, and schedule words 61-63 are never formed.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -42,19 +56,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..models.md5_jax import MD5_K, MD5_S
 from ..models.registry import get_hash_model
+from ..models.sha256_jax import SHA256_K
 from .difficulty import nibble_masks
 from .packing import build_tail_spec
 from .search_step import SENTINEL, _check_launch, mask_words_for
 
 LANES = 128
-# (64, 128) tile x 512 inner fori_loop iterations per grid step: the
-# tile height bounds live registers through the unrolled round chain
+# Per-model (sublanes, inner) tile geometry — see module docstring.
+# MD5: (64, 128) tile x 512 inner fori_loop iterations per grid step:
+# the tile height bounds live registers through the unrolled round chain
 # (taller tiles spill — 256 sublanes measured ~25% slower), the inner
 # loop amortizes per-grid-step fixed cost (TPU v5e sweep, BENCH_r02:
 # ~10.0 GH/s at (64, 512) vs 2.34 GH/s for round 1's flat (256,) grid;
-# inner auto-shrinks to divide smaller launches)
-DEFAULT_SUBLANES = 64
-DEFAULT_INNER = 512
+# inner auto-shrinks to divide smaller launches).  SHA-256's ~24-value
+# live set needs each value to be ONE (8, 128) vreg or the round chain
+# spills.
+MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (8, 1024)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 
 
@@ -123,6 +140,77 @@ def _md5_tile(words, init, mask_words: int = 4):
     return tuple(out)
 
 
+def _rotr(x, s: int):
+    return (x >> s) | (x << (32 - s))
+
+
+def _sha256_tile(words, init, mask_words: int = 8):
+    """DCE'd SHA-256 compression on a tile; ``words[g]`` array or scalar.
+
+    Functional A/E form: with ``A[r]``/``E[r]`` the new ``a``/``e`` after
+    round ``r`` (and ``A[-1..-4] = a0..d0``, ``E[-1..-4] = e0..h0``), one
+    round is
+
+        t1   = E[r-4] + S1(E[r-1]) + Ch(E[r-1..r-3]) + (K[r] + w[r])
+        E[r] = A[r-4] + t1
+        A[r] = t1 + S0(A[r-1]) + Maj(A[r-1..r-3])
+
+    and digest word j is ``init[j] + A[63-j]`` (j < 4) or
+    ``init[j] + E[67-j]`` (j >= 4).  ``mask_words`` trailing digest words
+    are live (ops/search_step.py mask_words_for), so the chains stop at
+
+        maxE = 59 + min(mask_words, 4)      (t1/E needed through there)
+        maxA = maxE - 4, or 59 + (mask_words - 4) when mask_words > 4
+
+    — for the dominant difficulty <= 8-nibble bucket that skips 3 full
+    rounds, 7 A-side updates, and schedule words 61-63, the same pruning
+    XLA's DCE applies to the fused step (2,909 vs 3,165 cost_analysis
+    ops/hash).  Returns 8 entries, ``None`` where dead.
+    """
+    mw = max(1, min(8, mask_words))
+    maxE = 59 + min(mw, 4)
+    maxA = max(maxE - 4, 59 + (mw - 4) if mw > 4 else -1)
+
+    w = list(words)
+    for i in range(16, maxE + 1):
+        w15, w7, w2 = w[i - 15], w[i - 7], w[i - 2]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+        w.append(w[i - 16] + s0 + w7 + s1)
+
+    A = {-4: init[3], -3: init[2], -2: init[1], -1: init[0]}
+    E = {-4: init[7], -3: init[6], -2: init[5], -1: init[4]}
+    for r in range(maxE + 1):
+        e1, f1, g1, h1 = E[r - 1], E[r - 2], E[r - 3], E[r - 4]
+        S1 = _rotr(e1, 6) ^ _rotr(e1, 11) ^ _rotr(e1, 25)
+        ch = (e1 & f1) ^ (~e1 & g1)
+        m = w[r]
+        if hasattr(m, "ndim") and m.ndim == 0 or not hasattr(m, "dtype"):
+            # constant message word: fold the round constant on the
+            # scalar unit (same trick as _md5_tile)
+            t1 = h1 + S1 + ch + (jnp.uint32(SHA256_K[r]) + jnp.uint32(m))
+        else:
+            t1 = h1 + S1 + ch + jnp.uint32(SHA256_K[r]) + m
+        E[r] = A[r - 4] + t1
+        if r <= maxA:
+            a1, b1, c1 = A[r - 1], A[r - 2], A[r - 3]
+            S0 = _rotr(a1, 2) ^ _rotr(a1, 13) ^ _rotr(a1, 22)
+            maj = (a1 & b1) ^ (a1 & c1) ^ (b1 & c1)
+            A[r] = t1 + S0 + maj
+
+    out = []
+    for j in range(8):
+        if j < 8 - mw:
+            out.append(None)
+        else:
+            out.append(init[j] + (A[63 - j] if j < 4 else E[67 - j]))
+    return tuple(out)
+
+
+_TILE_FNS = {"md5": (_md5_tile, 4, 4), "sha256": (_sha256_tile, 8, 8)}
+# model -> (tile fn, init-state words, digest words)
+
+
 @functools.lru_cache(maxsize=None)
 def _dyn_pallas_step(
     tb_word: int,
@@ -133,18 +221,19 @@ def _dyn_pallas_step(
     interpret: bool,
     inner: int = 1,
     mask_words: int = 4,
+    model_name: str = "md5",
 ):
     """Layout-keyed pallas program.
 
-    Returned jitted fn: ``(chunk0, init[4], base[16], masks[mask_words],
+    Returned jitted fn: ``(chunk0, init[S], base[16], masks[mask_words],
     part[2]=(tb_lo, log_tbc)) -> uint32`` (flat first-hit index or
-    SENTINEL).
+    SENTINEL), where ``S`` is the model's state width (md5 4, sha256 8).
 
     Each grid step evaluates ``inner`` consecutive (sublanes, 128) tiles
     in an on-device ``fori_loop``.  The split matters: sublanes bounds
     the live register set of the unrolled 64-round chain (too tall
     spills to VMEM), while inner amortizes the per-grid-step fixed cost
-    (index iota, bookkeeping, the cross-lane min) — see DEFAULT_SUBLANES
+    (index iota, bookkeeping, the cross-lane min) — see MODEL_GEOMETRY
     for the measured TPU v5e sweep.
 
     ``mask_words`` (the trailing-digest-word bucket of
@@ -153,7 +242,8 @@ def _dyn_pallas_step(
     ``_md5_tile``, matching the DCE XLA applies to the fused step.
     """
     tile = sublanes * LANES
-    mw = max(1, min(4, mask_words))
+    tile_fn, state_words, digest_words = _TILE_FNS[model_name]
+    mw = max(1, min(digest_words, mask_words))
 
     def kernel(chunk0_ref, init_ref, base_ref, masks_ref, part_ref, out_ref):
         i = pl.program_id(0)
@@ -167,7 +257,7 @@ def _dyn_pallas_step(
             + row * jnp.uint32(LANES)
             + col
         )
-        init = tuple(init_ref[j] for j in range(4))
+        init = tuple(init_ref[j] for j in range(state_words))
         consts = [base_ref[w] for w in range(16)]
 
         def tile_candidates(f):
@@ -186,10 +276,10 @@ def _dyn_pallas_step(
                 byte_j = (chunk >> jnp.uint32(8 * j)) & jnp.uint32(0xFF)
                 words[w_i] = words[w_i] | (byte_j << s_i)
 
-            state = _md5_tile(words, init, mw)
-            acc = state[4 - mw] & masks_ref[0]
+            state = tile_fn(words, init, mw)
+            acc = state[digest_words - mw] & masks_ref[0]
             for j in range(1, mw):
-                acc = acc | (state[4 - mw + j] & masks_ref[j])
+                acc = acc | (state[digest_words - mw + j] & masks_ref[j])
             hit = acc == jnp.uint32(0)
             return jnp.where(hit, f.astype(jnp.int32), jnp.int32(_I32_MISS))
 
@@ -250,10 +340,10 @@ def build_pallas_search_step(
     chunks_per_step: int,
     model_name: str = "md5",
     extra_const_chunk: bytes = b"",
-    sublanes: int = DEFAULT_SUBLANES,
+    sublanes: Optional[int] = None,
     interpret: bool = False,
     launch_steps: int = 1,
-    inner: int = DEFAULT_INNER,
+    inner: Optional[int] = None,
 ) -> Callable:
     """Build ``step(chunk0) -> uint32`` backed by the Pallas kernel.
 
@@ -264,13 +354,22 @@ def build_pallas_search_step(
     kernel simply extends its sequential TPU grid — the flat index
     already spans ``program_id * tile``, so a larger grid IS the
     multi-sub-batch launch, with no extra machinery.  Requires
-    ``tb_count`` to be a power of two and the MD5 model with a
-    single-block tail (the overwhelmingly common configuration); callers
-    fall back to the XLA path otherwise.
+    ``tb_count`` to be a power of two, an implemented model (md5 or
+    sha256), and a single-block tail (the overwhelmingly common
+    configuration); callers fall back to the XLA path otherwise.
+
+    ``sublanes``/``inner`` default to the model's tuned geometry
+    (MODEL_GEOMETRY); pass explicitly to sweep.
     """
     model = get_hash_model(model_name)
-    if model.name != "md5":
-        raise ValueError("pallas kernel currently implements the md5 model")
+    if model.name not in _TILE_FNS:
+        raise ValueError(
+            f"pallas kernel implements {sorted(_TILE_FNS)}, not {model.name}"
+        )
+    if sublanes is None:
+        sublanes = MODEL_GEOMETRY[model.name][0]
+    if inner is None:
+        inner = MODEL_GEOMETRY[model.name][1]
     if tb_count & (tb_count - 1):
         raise ValueError("pallas kernel requires power-of-two tb_count")
 
@@ -295,7 +394,8 @@ def build_pallas_search_step(
     _, tb_w, tb_s = spec.tb_loc
     chunk_ws = tuple((w, s) for _, w, s in spec.chunk_locs)
     dyn = _dyn_pallas_step(
-        tb_w, tb_s, chunk_ws, grid, sublanes, interpret, inner, mw
+        tb_w, tb_s, chunk_ws, grid, sublanes, interpret, inner, mw,
+        model.name,
     )
 
     init = jnp.asarray(spec.init_state, jnp.uint32)
@@ -321,10 +421,10 @@ def cached_pallas_search_step(
     chunks_per_step: int,
     model_name: str = "md5",
     extra_const_chunk: bytes = b"",
-    sublanes: int = DEFAULT_SUBLANES,
+    sublanes: Optional[int] = None,
     interpret: bool = False,
     launch_steps: int = 1,
-    inner: int = DEFAULT_INNER,
+    inner: Optional[int] = None,
 ):
     return build_pallas_search_step(
         nonce, width, difficulty, tb_lo, tb_count, chunks_per_step,
