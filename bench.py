@@ -203,15 +203,17 @@ def main() -> None:
     try:
         from distpow_tpu.ops.md5_pallas import build_pallas_search_step as _bps
 
+        k_shp = launch_steps_for(4, chunks, 256, 1 << 28)
+
         def sha_pallas_builder():
             step = _bps(
                 nonce, 4, difficulty, 0, 256, chunks,
-                model_name="sha256", launch_steps=k_sha,
+                model_name="sha256", launch_steps=k_shp,
             )
-            return step, chunks * 256 * k_sha
+            return step, chunks * 256 * k_shp
 
         rates["sha256-pallas"] = device_rate(
-            sha_pallas_builder, f"sha256 pallas kernel, k={k_sha}"
+            sha_pallas_builder, f"sha256 pallas kernel, k={k_shp}"
         )
     except Exception as exc:
         print(f"[bench] sha256 pallas bench failed: {exc}", file=sys.stderr)
@@ -327,7 +329,7 @@ def main() -> None:
         n = 1 << 21
         t0 = time.time()
         lib.distpow_search_range(
-            nonce, len(nonce), 32, tb, len(tb), 4, 1 << 24, n // 256,
+            nonce, len(nonce), 32, 0, tb, len(tb), 4, 1 << 24, n // 256,
             1, None, ctypes.byref(hashes), secret,
         )
         dt = time.time() - t0

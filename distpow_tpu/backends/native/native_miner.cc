@@ -13,8 +13,12 @@
 // encoding of a chunk integer; for each chunk all thread bytes are tried
 // in order (chunk-major, thread-byte-minor = reference order).
 //
-// MD5 implemented from the RFC 1321 specification (single translation
-// unit, no dependencies).
+// MD5 implemented from the RFC 1321 specification, SHA-256 from FIPS
+// 180-4 (single translation unit, no dependencies).  The hash is a
+// compile-time trait of the templated scan loop, mirroring the
+// framework's pluggable hash-model registry (models/registry.py): both
+// algorithms share the enumeration, cancellation, and threading
+// machinery exactly.
 
 #include <atomic>
 #include <cstdint>
@@ -51,7 +55,7 @@ constexpr int kS[64] = {7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22, 7,
 inline uint32_t Rotl(uint32_t x, int s) { return (x << s) | (x >> (32 - s)); }
 
 // One MD5 block compression over a 64-byte block.
-void Compress(uint32_t state[4], const uint8_t block[64]) {
+void CompressMd5(uint32_t state[4], const uint8_t block[64]) {
   uint32_t m[16];
   std::memcpy(m, block, 64);  // little-endian hosts only (x86/ARM LE)
   uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
@@ -83,15 +87,103 @@ void Compress(uint32_t state[4], const uint8_t block[64]) {
   state[3] += d;
 }
 
-// Trailing zero nibbles of the 16-byte digest, scanned from the end:
-// low nibble of the last byte first (hex-string order).
-inline bool MeetsDifficulty(const uint8_t digest[16], uint32_t nibbles) {
+// --- SHA-256 (FIPS 180-4) --------------------------------------------------
+
+constexpr uint32_t kShaInit[8] = {0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u,
+                                  0xa54ff53au, 0x510e527fu, 0x9b05688cu,
+                                  0x1f83d9abu, 0x5be0cd19u};
+
+constexpr uint32_t kShaK[64] = {
+    0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+    0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+    0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+    0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+    0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+    0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+    0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+    0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+    0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+    0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+    0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+    0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+    0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+
+inline uint32_t Rotr(uint32_t x, int s) { return (x >> s) | (x << (32 - s)); }
+
+void CompressSha256(uint32_t state[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; ++i) {
+    w[i] = (static_cast<uint32_t>(block[4 * i]) << 24) |
+           (static_cast<uint32_t>(block[4 * i + 1]) << 16) |
+           (static_cast<uint32_t>(block[4 * i + 2]) << 8) |
+           static_cast<uint32_t>(block[4 * i + 3]);
+  }
+  for (int i = 16; i < 64; ++i) {
+    const uint32_t s0 =
+        Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    const uint32_t s1 =
+        Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; ++i) {
+    const uint32_t S1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+    const uint32_t ch = (e & f) ^ (~e & g);
+    const uint32_t t1 = h + S1 + ch + kShaK[i] + w[i];
+    const uint32_t S0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+    const uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    const uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+// --- hash traits bound into the templated scan loop ------------------------
+
+struct Md5Traits {
+  static constexpr int kStateWords = 4;
+  static constexpr int kDigestBytes = 16;
+  static constexpr bool kBigEndianLength = false;
+  static const uint32_t* Init() { return kInitState; }
+  static void Compress(uint32_t* state, const uint8_t* block) {
+    CompressMd5(state, block);
+  }
+  static void StoreDigest(const uint32_t* state, uint8_t* out) {
+    std::memcpy(out, state, 16);  // MD5 digest = LE state bytes
+  }
+};
+
+struct Sha256Traits {
+  static constexpr int kStateWords = 8;
+  static constexpr int kDigestBytes = 32;
+  static constexpr bool kBigEndianLength = true;
+  static const uint32_t* Init() { return kShaInit; }
+  static void Compress(uint32_t* state, const uint8_t* block) {
+    CompressSha256(state, block);
+  }
+  static void StoreDigest(const uint32_t* state, uint8_t* out) {
+    for (int i = 0; i < 8; ++i) {  // big-endian word serialization
+      out[4 * i] = static_cast<uint8_t>(state[i] >> 24);
+      out[4 * i + 1] = static_cast<uint8_t>(state[i] >> 16);
+      out[4 * i + 2] = static_cast<uint8_t>(state[i] >> 8);
+      out[4 * i + 3] = static_cast<uint8_t>(state[i]);
+    }
+  }
+};
+
+// Trailing zero nibbles of the digest, scanned from the end: low nibble
+// of the last byte first (hex-string order).
+inline bool MeetsDifficulty(const uint8_t* digest, int digest_bytes,
+                            uint32_t nibbles) {
   uint32_t full = nibbles / 2;
   for (uint32_t i = 0; i < full; ++i) {
-    if (digest[15 - i] != 0) return false;
+    if (digest[digest_bytes - 1 - i] != 0) return false;
   }
   if (nibbles & 1) {
-    if ((digest[15 - full] & 0x0f) != 0) return false;
+    if ((digest[digest_bytes - 1 - full] & 0x0f) != 0) return false;
   }
   return true;
 }
@@ -115,6 +207,7 @@ struct Found {
 
 // Scan [chunk_lo, chunk_hi) in reference order; update `found` with the
 // minimum flat index seen.  Checks cancel/found every `poll` candidates.
+template <typename Traits>
 void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
                Found* found, uint64_t* hashes_out) {
   const size_t msg_len = t.nonce_len + 1 + t.width;
@@ -126,11 +219,11 @@ void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
   uint64_t next_poll = poll;
 
   // Precompute the constant prefix state for long messages.
-  uint32_t prefix_state[4];
-  std::memcpy(prefix_state, kInitState, sizeof(prefix_state));
+  uint32_t prefix_state[Traits::kStateWords];
+  std::memcpy(prefix_state, Traits::Init(), sizeof(prefix_state));
   size_t absorbed = (t.nonce_len / 64) * 64;
   for (size_t off = 0; off < absorbed; off += 64) {
-    Compress(prefix_state, t.nonce + off);
+    Traits::Compress(prefix_state, t.nonce + off);
   }
   const uint8_t* rem = t.nonce + absorbed;
   const size_t rem_len = t.nonce_len - absorbed;
@@ -143,7 +236,8 @@ void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
   tail[tail_content] = 0x80;
   const uint64_t bitlen = static_cast<uint64_t>(msg_len) * 8;
   for (int i = 0; i < 8; ++i) {
-    tail[tail_len - 8 + i] = static_cast<uint8_t>(bitlen >> (8 * i));
+    const int shift = Traits::kBigEndianLength ? 8 * (7 - i) : 8 * i;
+    tail[tail_len - 8 + i] = static_cast<uint8_t>(bitlen >> shift);
   }
 
   for (uint64_t chunk = chunk_lo; chunk < chunk_hi; ++chunk) {
@@ -161,15 +255,15 @@ void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
         }
       }
       tail[rem_len] = t.thread_bytes[ti];
-      uint32_t state[4];
+      uint32_t state[Traits::kStateWords];
       std::memcpy(state, prefix_state, sizeof(state));
       for (size_t b = 0; b < tail_blocks; ++b) {
-        Compress(state, tail + 64 * b);
+        Traits::Compress(state, tail + 64 * b);
       }
       ++hashes;
-      uint8_t digest[16];
-      std::memcpy(digest, state, 16);
-      if (MeetsDifficulty(digest, t.difficulty)) {
+      uint8_t digest[Traits::kDigestBytes];
+      Traits::StoreDigest(state, digest);
+      if (MeetsDifficulty(digest, Traits::kDigestBytes, t.difficulty)) {
         const uint64_t flat =
             (chunk - t.chunk_start) * t.n_tb + static_cast<uint64_t>(ti);
         uint64_t cur = found->flat_index.load(std::memory_order_relaxed);
@@ -183,6 +277,54 @@ void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
     }
   }
   *hashes_out += hashes;
+}
+
+template <typename Traits>
+int SearchRange(const SearchTask& task, uint64_t chunk_count,
+                int32_t n_threads, Found* found, uint64_t* hashes) {
+  if (n_threads <= 1 || chunk_count < 2) {
+    ScanRange<Traits>(task, task.chunk_start, task.chunk_end, found, hashes);
+  } else {
+    const uint64_t nt = static_cast<uint64_t>(n_threads);
+    const uint64_t per = (chunk_count + nt - 1) / nt;
+    std::vector<std::thread> threads;
+    std::vector<uint64_t> thread_hashes(nt, 0);
+    for (uint64_t i = 0; i < nt; ++i) {
+      const uint64_t lo = task.chunk_start + i * per;
+      const uint64_t hi =
+          lo + per < task.chunk_end ? lo + per : task.chunk_end;
+      if (lo >= hi) break;
+      threads.emplace_back([&, lo, hi, i] {
+        ScanRange<Traits>(task, lo, hi, found, &thread_hashes[i]);
+      });
+    }
+    for (auto& th : threads) th.join();
+    for (uint64_t h : thread_hashes) *hashes += h;
+  }
+  return 0;
+}
+
+// Full digest of an arbitrary buffer (self-test hooks below).
+template <typename Traits>
+void DigestBuffer(const uint8_t* data, size_t len, uint8_t* out) {
+  uint32_t state[Traits::kStateWords];
+  std::memcpy(state, Traits::Init(), sizeof(state));
+  size_t full = (len / 64) * 64;
+  for (size_t off = 0; off < full; off += 64)
+    Traits::Compress(state, data + off);
+  uint8_t tail[128];
+  std::memset(tail, 0, sizeof(tail));
+  size_t rem = len - full;
+  std::memcpy(tail, data + full, rem);
+  tail[rem] = 0x80;
+  size_t tail_len = rem + 9 <= 64 ? 64 : 128;
+  uint64_t bits = static_cast<uint64_t>(len) * 8;
+  for (int i = 0; i < 8; ++i) {
+    const int shift = Traits::kBigEndianLength ? 8 * (7 - i) : 8 * i;
+    tail[tail_len - 8 + i] = static_cast<uint8_t>(bits >> shift);
+  }
+  for (size_t b = 0; b < tail_len; b += 64) Traits::Compress(state, tail + b);
+  Traits::StoreDigest(state, out);
 }
 
 }  // namespace
@@ -201,37 +343,33 @@ extern "C" {
 // order within each thread's range; across threads, first-in-order among
 // the finds that happened before shutdown — any valid secret is
 // acceptable per the puzzle contract, coordinator.go:202).
+//
+// `algo`: 0 = MD5 (reference parity), 1 = SHA-256 (the north-star hash
+// option); -2 on any other value.
 int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
-                         uint32_t difficulty, const uint8_t* thread_bytes,
+                         uint32_t difficulty, uint32_t algo,
+                         const uint8_t* thread_bytes,
                          size_t n_tb, uint32_t width, uint64_t chunk_start,
                          uint64_t chunk_count, int32_t n_threads,
                          const volatile int32_t* cancel_flag,
                          uint64_t* out_hashes, uint8_t* out_secret) {
-  if (n_tb == 0 || width > 8) return -2;
+  if (n_tb == 0 || width > 8 || algo > 1) return -2;
+  // a difficulty beyond the digest's nibble count would read past the
+  // digest buffer in MeetsDifficulty (and the puzzle is unsatisfiable
+  // anyway — the JAX paths reject it in nibble_masks)
+  const uint32_t max_nibbles =
+      2 * (algo == 0 ? Md5Traits::kDigestBytes : Sha256Traits::kDigestBytes);
+  if (difficulty > max_nibbles) return -2;
   SearchTask task{nonce,        nonce_len,  difficulty,
                   thread_bytes, n_tb,       width,
                   chunk_start,  chunk_start + chunk_count, cancel_flag};
   Found found;
   uint64_t hashes = 0;
 
-  if (n_threads <= 1 || chunk_count < 2) {
-    ScanRange(task, task.chunk_start, task.chunk_end, &found, &hashes);
+  if (algo == 0) {
+    SearchRange<Md5Traits>(task, chunk_count, n_threads, &found, &hashes);
   } else {
-    const uint64_t nt = static_cast<uint64_t>(n_threads);
-    const uint64_t per = (chunk_count + nt - 1) / nt;
-    std::vector<std::thread> threads;
-    std::vector<uint64_t> thread_hashes(nt, 0);
-    for (uint64_t i = 0; i < nt; ++i) {
-      const uint64_t lo = task.chunk_start + i * per;
-      const uint64_t hi =
-          lo + per < task.chunk_end ? lo + per : task.chunk_end;
-      if (lo >= hi) break;
-      threads.emplace_back([&, lo, hi, i] {
-        ScanRange(task, lo, hi, &found, &thread_hashes[i]);
-      });
-    }
-    for (auto& th : threads) th.join();
-    for (uint64_t h : thread_hashes) hashes += h;
+    SearchRange<Sha256Traits>(task, chunk_count, n_threads, &found, &hashes);
   }
 
   if (out_hashes) *out_hashes = hashes;
@@ -248,23 +386,13 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
   return 0;
 }
 
-// Self-test hook: MD5 of an arbitrary buffer (for binding-level checks).
+// Self-test hooks: full digests of an arbitrary buffer (binding checks).
 void distpow_md5(const uint8_t* data, size_t len, uint8_t out[16]) {
-  uint32_t state[4];
-  std::memcpy(state, kInitState, sizeof(state));
-  size_t full = (len / 64) * 64;
-  for (size_t off = 0; off < full; off += 64) Compress(state, data + off);
-  uint8_t tail[128];
-  std::memset(tail, 0, sizeof(tail));
-  size_t rem = len - full;
-  std::memcpy(tail, data + full, rem);
-  tail[rem] = 0x80;
-  size_t tail_len = rem + 9 <= 64 ? 64 : 128;
-  uint64_t bits = static_cast<uint64_t>(len) * 8;
-  for (int i = 0; i < 8; ++i)
-    tail[tail_len - 8 + i] = static_cast<uint8_t>(bits >> (8 * i));
-  for (size_t b = 0; b < tail_len; b += 64) Compress(state, tail + b);
-  std::memcpy(out, state, 16);
+  DigestBuffer<Md5Traits>(data, len, out);
+}
+
+void distpow_sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  DigestBuffer<Sha256Traits>(data, len, out);
 }
 
 }  // extern "C"

@@ -14,6 +14,7 @@ import logging
 import os
 import subprocess
 import threading
+import time
 from typing import Callable, Optional, Sequence
 
 from ..models import puzzle
@@ -56,6 +57,7 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_search_range.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,          # nonce
             ctypes.c_uint32,                            # difficulty
+            ctypes.c_uint32,                            # algo (0 md5, 1 sha256)
             ctypes.c_char_p, ctypes.c_size_t,          # thread bytes
             ctypes.c_uint32,                            # width
             ctypes.c_uint64, ctypes.c_uint64,          # chunk start/count
@@ -68,14 +70,28 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_md5.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.distpow_sha256.restype = None
+        lib.distpow_sha256.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
         _lib = lib
         return lib
+
+
+ALGO_IDS = {"md5": 0, "sha256": 1}
 
 
 def native_md5(data: bytes) -> bytes:
     lib = load_library()
     out = ctypes.create_string_buffer(16)
     lib.distpow_md5(data, len(data), out)
+    return out.raw
+
+
+def native_sha256(data: bytes) -> bytes:
+    lib = load_library()
+    out = ctypes.create_string_buffer(32)
+    lib.distpow_sha256(data, len(data), out)
     return out.raw
 
 
@@ -91,8 +107,13 @@ class NativeBackend:
         range_size: int = 1 << 22,
         **_,
     ):
-        if hash_model != "md5":
-            raise ValueError("native backend implements the md5 model")
+        if hash_model not in ALGO_IDS:
+            raise ValueError(
+                f"native backend implements {sorted(ALGO_IDS)}, "
+                f"not {hash_model!r}"
+            )
+        self.hash_model = hash_model
+        self.algo = ALGO_IDS[hash_model]
         self.n_threads = n_threads or (os.cpu_count() or 1)
         self.range_size = range_size
         self.lib = load_library()
@@ -105,6 +126,19 @@ class NativeBackend:
         cancel_check: Optional[Callable[[], bool]] = None,
     ) -> Optional[bytes]:
         nonce = bytes(nonce)
+        max_nibbles = {"md5": 32, "sha256": 64}[self.hash_model]
+        if difficulty > max_nibbles:
+            # unsatisfiable: same contract as the JAX driver
+            # (parallel/search.py) — the reference would brute-force
+            # forever, so block on the cancel gate instead of burning
+            # CPU (the C library also guards with rc=-2, so an
+            # out-of-range difficulty can never over-read the digest
+            # buffer in MeetsDifficulty)
+            while True:
+                if cancel_check is not None and cancel_check():
+                    metrics.inc("search.cancelled")
+                    return None
+                time.sleep(0.01)
         contiguous_bounds(thread_bytes)  # validates the run
         tb_buf = bytes(thread_bytes)
         cancel = ctypes.c_int32(0)
@@ -145,7 +179,7 @@ class NativeBackend:
                     count = min(self.range_size, full_hi - start)
                     rc = self.lib.distpow_search_range(
                         nonce, len(nonce),
-                        difficulty,
+                        difficulty, self.algo,
                         tb_buf, len(tb_buf),
                         width,
                         start, count,
@@ -157,7 +191,8 @@ class NativeBackend:
                     account()
                     if rc == 1:
                         secret = secret_buf.raw[: 1 + width]
-                        if not puzzle.check_secret(nonce, secret, difficulty):
+                        if not puzzle.check_secret(nonce, secret, difficulty,
+                                                   algo=self.hash_model):
                             raise RuntimeError(
                                 "native miner returned non-solving secret "
                                 f"{secret.hex()}"

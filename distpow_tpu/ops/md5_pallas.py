@@ -207,8 +207,12 @@ def _sha256_tile(words, init, mask_words: int = 8):
     return tuple(out)
 
 
+# model -> (tile fn, init-state words, digest words); a model has a
+# kernel iff it has an entry here, and MODEL_GEOMETRY above is checked
+# against this at import so the two can't drift apart.
 _TILE_FNS = {"md5": (_md5_tile, 4, 4), "sha256": (_sha256_tile, 8, 8)}
-# model -> (tile fn, init-state words, digest words)
+assert set(_TILE_FNS) == set(MODEL_GEOMETRY), \
+    "every pallas kernel model needs a MODEL_GEOMETRY entry and vice versa"
 
 
 @functools.lru_cache(maxsize=None)

@@ -29,6 +29,52 @@ def test_native_md5_vs_hashlib(length):
     assert native.native_md5(data) == hashlib.md5(data).digest()
 
 
+@pytest.mark.parametrize("length", [0, 1, 55, 56, 64, 130])
+def test_native_sha256_vs_hashlib(length):
+    import random
+
+    rng = random.Random(1000 + length)
+    data = bytes(rng.randrange(256) for _ in range(length))
+    assert native.native_sha256(data) == hashlib.sha256(data).digest()
+
+
+def test_native_backend_sha256_matches_oracle():
+    """The traits-templated scan loop must give reference enumeration
+    order for the SHA-256 model too (models/registry.py pluggability,
+    completing the model x backend matrix on the CPU perf path)."""
+    backend = native.NativeBackend(hash_model="sha256", n_threads=1)
+    for nonce in (b"\x01\x02\x03\x04", b"\xaa\xbb"):
+        for difficulty in (1, 2, 3):
+            tbs = list(range(256))
+            secret = backend.search(nonce, difficulty, tbs)
+            assert secret == puzzle.python_search(
+                nonce, difficulty, tbs, algo="sha256")
+
+
+def test_native_backend_sha256_long_nonce_multiblock():
+    backend = native.NativeBackend(hash_model="sha256", n_threads=1)
+    nonce = bytes(range(150))
+    secret = backend.search(nonce, 2, list(range(256)))
+    assert secret == puzzle.python_search(nonce, 2, list(range(256)),
+                                          algo="sha256")
+
+
+def test_native_backend_rejects_unknown_model():
+    with pytest.raises(ValueError, match="native backend implements"):
+        native.NativeBackend(hash_model="sha1")
+
+
+def test_native_backend_unsatisfiable_difficulty_blocks_until_cancel():
+    """difficulty > digest nibbles must block on the cancel gate (the
+    reference parity contract, parallel/search.py) — never raise, never
+    over-read the digest buffer in the C scan loop."""
+    backend = native.NativeBackend(hash_model="md5", n_threads=1)
+    ev = threading.Event()
+    threading.Timer(0.1, ev.set).start()
+    assert backend.search(b"\x01", 33, list(range(256)),
+                          cancel_check=ev.is_set) is None
+
+
 def test_native_backend_matches_oracle_single_thread():
     backend = native.NativeBackend(n_threads=1)
     for nonce in (b"\x01\x02\x03\x04", b"\xaa\xbb"):
