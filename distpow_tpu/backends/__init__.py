@@ -9,10 +9,12 @@ backend selected by ``WorkerConfig.Backend``:
                  present), batched + pipelined (parallel/search.py)
 * ``jax-mesh`` — shard_map over all local devices, prefix->core
                  (parallel/mesh_search.py)
-* ``pallas``   — hand-written TPU kernel for the MD5 hot op
-                 (ops/md5_pallas.py) behind the same driver
+* ``pallas``   — hand-written TPU kernels for the hot op
+                 (ops/md5_pallas.py: MD5 + SHA-256) behind the same driver
+* ``pallas-mesh`` — the same kernels spread over the local device mesh
+                 (prefix->core + ``lax.pmin``, parallel/mesh_search.py)
 * ``native``   — C++ miner via ctypes (backends/native/), the CPU
-                 performance path
+                 performance path (MD5 + SHA-256)
 
 Every backend implements ``search(nonce, difficulty, thread_bytes,
 cancel_check) -> Optional[bytes]`` returning the first solving secret in
@@ -180,9 +182,18 @@ class JaxMeshBackend:
             self._mesh = make_mesh(devs)
         return self._mesh
 
-    def warmup(self, nonce_lens: Sequence[int], widths: Sequence[int]) -> None:
+    def _step_factory(self, nonce: bytes, difficulty: int, tb_lo: int,
+                      tbc: int):
+        """Step-factory hook — the ONLY thing kernel-backed mesh
+        subclasses override; warmup and search both build through it, so
+        compile-key discipline is inherited, not duplicated."""
         from ..parallel.mesh_search import AXIS, _mesh_step_factory
 
+        return _mesh_step_factory(
+            nonce, difficulty, tb_lo, tbc, self.model, self._get_mesh(), AXIS
+        )
+
+    def warmup(self, nonce_lens: Sequence[int], widths: Sequence[int]) -> None:
         mesh = self._get_mesh()
         n_dev = int(mesh.devices.size)
         if n_dev & (n_dev - 1):
@@ -201,9 +212,7 @@ class JaxMeshBackend:
             return
 
         def build(nonce, tbc, difficulty):
-            return _mesh_step_factory(
-                nonce, difficulty, 0, tbc, self.model, mesh, AXIS
-            )
+            return self._step_factory(nonce, difficulty, 0, tbc)
 
         _warm_layouts(build, nonce_lens, widths, self.batch_size,
                       max_launch=self.max_launch)
@@ -218,7 +227,10 @@ class JaxMeshBackend:
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
         from ..parallel.mesh_search import search_mesh
+        from ..parallel.search import contiguous_bounds
 
+        nonce = bytes(nonce)
+        tb_lo, tbc = contiguous_bounds(thread_bytes)
         res = search_mesh(
             nonce,
             difficulty,
@@ -228,8 +240,52 @@ class JaxMeshBackend:
             batch_size=self.batch_size,
             cancel_check=cancel_check,
             launch_candidates=self.max_launch,
+            step_factory=self._step_factory(nonce, difficulty, tb_lo, tbc),
         )
         return None if res is None else res.secret
+
+
+class PallasMeshBackend(JaxMeshBackend):
+    """The Pallas kernel spread over the local device mesh.
+
+    Same prefix->core sharding and ``lax.pmin`` found-collective as
+    ``jax-mesh``, but each device runs the hand-written kernel
+    (ops/md5_pallas.py) instead of the fused XLA step — one compiled
+    kernel program serves every device because the partition descriptor
+    is a runtime SMEM operand (parallel/mesh_search.py
+    _dyn_pallas_mesh_step).  Configurations the kernel cannot express
+    fall back to the XLA mesh factory per width, transparently.
+    Warmup/search flow is inherited — only the step factory differs.
+    """
+
+    name = "pallas-mesh"
+
+    def __init__(self, *args, interpret: bool = False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.interpret = interpret
+
+    def _step_factory(self, nonce: bytes, difficulty: int, tb_lo: int,
+                      tbc: int):
+        from ..parallel.mesh_search import AXIS, _pallas_mesh_step_factory
+
+        xla_factory = super()._step_factory(nonce, difficulty, tb_lo, tbc)
+        try:
+            pallas_factory = _pallas_mesh_step_factory(
+                nonce, difficulty, tb_lo, tbc, self.model, self._get_mesh(),
+                AXIS, interpret=self.interpret, max_launch=self.max_launch,
+            )
+        except ValueError as exc:
+            log.info("pallas-mesh: %s; serving via the XLA mesh step", exc)
+            return xla_factory
+
+        def factory(vw, extra, target_chunks, launch_steps=1):
+            try:
+                return pallas_factory(vw, extra, target_chunks, launch_steps)
+            except ValueError:
+                # e.g. multi-block tail for this nonce length
+                return xla_factory(vw, extra, target_chunks, launch_steps)
+
+        return factory
 
 
 def get_backend(name: str, **kwargs):
@@ -240,6 +296,8 @@ def get_backend(name: str, **kwargs):
         return JaxBackend(**kwargs)
     if name in ("jax-mesh", "mesh"):
         return JaxMeshBackend(**kwargs)
+    if name == "pallas-mesh":
+        return PallasMeshBackend(**kwargs)
     if name == "pallas":
         from .pallas_backend import PallasBackend
 

@@ -1,7 +1,8 @@
 """Worker entry point (cmd/worker/main.go equivalent).
 
     python -m distpow_tpu.cli.worker [--config PATH] [--id ID]
-        [--listen ADDR] [--backend {python,jax,jax-mesh,pallas,native}]
+        [--listen ADDR]
+        [--backend {python,jax,jax-mesh,pallas,pallas-mesh,native}]
         [--jax-coordinator HOST:PORT --jax-num-processes N --jax-process-id I]
 
 ``--id`` and ``--listen`` override the config file the same way the

@@ -155,6 +155,194 @@ def _dyn_mesh_step(
     return jax.jit(sharded)
 
 
+@functools.lru_cache(maxsize=None)
+def _dyn_pallas_mesh_step(
+    mesh: Mesh,
+    axis: str,
+    model_name: str,
+    tb_word: int,
+    tb_shift: int,
+    chunk_word_shifts,
+    grid: int,
+    sublanes: int,
+    inner: int,
+    interpret: bool,
+    mask_words: int,
+    tb_split: bool,
+    log_ndev: int,
+    batch_local: int,
+    launch_steps: int,
+):
+    """The Pallas search kernel spread over the device mesh.
+
+    One compiled kernel program serves every device: the kernel's
+    partition descriptor and chunk base are runtime SMEM operands, so
+    inside ``shard_map`` each device derives its own from
+    ``axis_index`` — tb-split hands device ``d`` the thread-byte slice
+    ``(tb_lo + d*tbl, log2 tbl)``; chunk-split hands it a contiguous
+    chunk span ``chunk0 + d * launch_steps * chunks_local``.  The
+    kernel's local first-hit flat index is then mapped back to the TRUE
+    global flat index (chunk-major over the whole worker partition) and
+    ``lax.pmin`` picks the first hit in reference enumeration order —
+    identical driver semantics to the XLA mesh step.
+
+    Note the chunk-split DEVICE assignment differs from the XLA mesh
+    step's (contiguous spans here vs per-sub-batch interleaving there):
+    both cover the same candidate set and both return the minimal
+    global flat index, so results are bit-identical either way.
+    """
+    from ..ops.md5_pallas import _dyn_pallas_step
+
+    kernel = _dyn_pallas_step(
+        tb_word, tb_shift, chunk_word_shifts, grid, sublanes, interpret,
+        inner, mask_words, model_name,
+    )
+    one = jnp.uint32(1)
+    _check_launch(batch_local << log_ndev, launch_steps)
+    span_local = jnp.uint32(launch_steps * batch_local)
+
+    def body(init, base, masks, part, chunk0):
+        d = jax.lax.axis_index(axis).astype(jnp.uint32)
+        tb_lo, log_tbc = part[0], part[1]
+        if tb_split:
+            log_tbl = log_tbc - jnp.uint32(log_ndev)
+            part_dev = jnp.stack(
+                [tb_lo + (d << log_tbl), log_tbl]).astype(jnp.uint32)
+            f_l = kernel(jnp.uint32(chunk0), init, base, masks, part_dev)
+            chunk_off = f_l >> log_tbl
+            rest = f_l & ((one << log_tbl) - one)
+            f_g = (chunk_off << log_tbc) + (d << log_tbl) + rest
+        else:
+            chunk_span = span_local >> log_tbc  # chunks per device
+            c0_dev = jnp.uint32(chunk0) + d * chunk_span
+            f_l = kernel(c0_dev, init, base, masks, part)
+            f_g = d * span_local + f_l
+        f_g = jnp.where(f_l == jnp.uint32(SENTINEL), jnp.uint32(SENTINEL),
+                        f_g)
+        return jax.lax.pmin(f_g, axis)
+
+    # check_vma=False: pallas_call's out_shape carries no varying-axes
+    # annotation, so shard_map's per-value VMA typing cannot see that the
+    # kernel output is device-varying; the explicit pmin below is the
+    # collective that makes the result replicated regardless.
+    sharded = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P(), P(), P(), P()), out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def _pallas_mesh_step_factory(
+    nonce: bytes,
+    difficulty: int,
+    tb_lo: int,
+    tbc: int,
+    model: HashModel,
+    mesh: Mesh,
+    axis: str,
+    sublanes: Optional[int] = None,
+    inner: Optional[int] = None,
+    interpret: bool = False,
+    max_launch: Optional[int] = None,
+) -> StepFactory:
+    """Step factory backed by the Pallas kernel per device.
+
+    Raises ValueError for configurations the kernel cannot express
+    (non-pow2 device count or partition, multi-block tails, models
+    without a kernel); ``PallasMeshBackend`` catches these per width and
+    falls back to the XLA mesh factory transparently.
+    """
+    from ..ops.md5_pallas import LANES, MODEL_GEOMETRY
+
+    n_dev = int(mesh.devices.size)
+    if n_dev & (n_dev - 1):
+        raise ValueError("pallas mesh requires a power-of-two device count")
+    if tbc & (tbc - 1):
+        raise ValueError("pallas kernel requires power-of-two tb_count")
+    if model.name not in MODEL_GEOMETRY:
+        raise ValueError(f"no pallas kernel for model {model.name}")
+    if sublanes is None:
+        sublanes = MODEL_GEOMETRY[model.name][0]
+    if inner is None:
+        inner = MODEL_GEOMETRY[model.name][1]
+    tile = sublanes * LANES
+    tb_split = tbc >= n_dev and tbc % n_dev == 0
+    log_ndev = n_dev.bit_length() - 1
+    tbl = tbc // n_dev if tb_split else tbc
+
+    @functools.lru_cache(maxsize=32)
+    def bind(vw: int, extra: bytes, chunks_local: int, launch_steps: int):
+        spec = build_tail_spec(bytes(nonce), vw, model, extra)
+        if spec.n_blocks != 1:
+            raise ValueError("pallas kernel requires a single-block tail")
+        batch_local = chunks_local * tbl
+        mw = mask_words_for(difficulty, model)
+        inner_eff = max(1, inner)
+        tiles = batch_local * launch_steps // tile
+        while tiles % inner_eff:
+            inner_eff //= 2
+        grid = tiles // inner_eff
+        _, tb_w, tb_s = spec.tb_loc
+        chunk_ws = tuple((w, s) for _, w, s in spec.chunk_locs)
+        dyn = _dyn_pallas_mesh_step(
+            mesh, axis, model.name, tb_w, tb_s, chunk_ws, grid, sublanes,
+            inner_eff, interpret, mw, tb_split, log_ndev, batch_local,
+            launch_steps,
+        )
+        init, base, masks = step_operands(spec, difficulty, model)
+        part = jnp.asarray([tb_lo, tbc.bit_length() - 1], jnp.uint32)
+
+        def step(chunk0):
+            return dyn(init, base[0], masks, part, chunk0)
+
+        return step
+
+    def factory(vw: int, extra: bytes, target_chunks: int, launch_steps: int = 1):
+        if vw == 0:
+            # width-0 probe: single-device layout-keyed program
+            return (
+                cached_search_step(
+                    bytes(nonce), 0, difficulty, tb_lo, tbc, 1,
+                    model.name, bytes(extra),
+                ),
+                1,
+            )
+        if tb_split:
+            chunks_local = max(1, target_chunks)
+        else:
+            # chunk split: normalize the per-device budget by n_dev, as
+            # _mesh_step_factory does — otherwise each device gets the
+            # FULL effective batch and one dispatch covers n_dev x the
+            # configured launch budget (cancellation latency, overscan,
+            # and VMEM-resident work all inflate n_dev-fold)
+            eb_local = max(256, (target_chunks * tbc // n_dev) // 256 * 256)
+            chunks_local = max(1, eb_local // tbc)
+        batch_local = chunks_local * tbl
+        # round the per-device batch up to a whole tile grid
+        if batch_local % tile:
+            batch_local = ((batch_local // tile) + 1) * tile
+            chunks_local = max(1, batch_local // tbl)
+            batch_local = chunks_local * tbl
+            if batch_local % tile:
+                raise ValueError(
+                    f"per-device batch {batch_local} (tbl={tbl}) cannot "
+                    f"align to tile {tile}"
+                )
+        # re-clamp the launch multiplier to the rounded GLOBAL batch:
+        # the driver computed launch_steps for the unrounded batch, and
+        # the launch must respect both the dispatch budget and the
+        # uint32/int32 flat-index bound
+        batch_global = batch_local << log_ndev
+        budget = min(max_launch or (1 << 31) - 1, (1 << 31) - 1)
+        k = max(1, min(launch_steps, budget // batch_global))
+        step = bind(vw, bytes(extra), chunks_local, k)
+        global_chunks = (chunks_local if tb_split
+                         else chunks_local * n_dev) * k
+        return step, global_chunks
+
+    return factory
+
+
 def _mesh_step_factory(
     nonce: bytes,
     difficulty: int,
@@ -284,13 +472,18 @@ def search_mesh(
     mesh: Optional[Mesh] = None,
     axis: str = AXIS,
     model: Optional[HashModel] = None,
+    step_factory: Optional[StepFactory] = None,
     **kwargs,
 ) -> Optional[SearchResult]:
-    """Mesh-parallel ``search`` with identical semantics and result decode."""
+    """Mesh-parallel ``search`` with identical semantics and result decode.
+
+    ``step_factory`` overrides the default XLA mesh factory — the
+    pallas-mesh backend plugs its kernel-backed factory in here.
+    """
     model = model or get_hash_model("md5")
     mesh = mesh if mesh is not None else make_mesh()
     tb_lo, tbc = contiguous_bounds(thread_bytes)
-    factory = _mesh_step_factory(
+    factory = step_factory or _mesh_step_factory(
         bytes(nonce), difficulty, tb_lo, tbc, model, mesh, axis
     )
     return search(

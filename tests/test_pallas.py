@@ -129,6 +129,50 @@ def test_sha256_pallas_kernel_matches_xla_step():
         assert int(step_p(jnp.uint32(c0))) == int(step_x(jnp.uint32(c0)))
 
 
+def test_pallas_mesh_matches_jax_mesh_all_partitions():
+    """pallas-mesh must be bit-identical to jax-mesh in both sharding
+    regimes (tb-split, chunk-split) and on sub-partitions — both return
+    the minimal TRUE global flat index, so the decoded secrets match
+    exactly (parallel/mesh_search.py _dyn_pallas_mesh_step)."""
+    from distpow_tpu.backends import JaxMeshBackend, PallasMeshBackend
+
+    b = PallasMeshBackend(batch_size=1 << 14, interpret=True)
+    ref = JaxMeshBackend(batch_size=1 << 14)
+    for tbs in (list(range(256)),        # tb-split
+                list(range(64, 128)),    # tb-split, sub-partition
+                list(range(4))):         # chunk-split (tbc < n_dev)
+        got = b.search(b"\x01\x02\x03", 2, tbs)
+        want = ref.search(b"\x01\x02\x03", 2, tbs)
+        assert got == want
+        assert puzzle.check_secret(b"\x01\x02\x03", got, 2)
+
+
+def test_pallas_mesh_falls_back_for_long_nonce():
+    from distpow_tpu.backends import PallasMeshBackend
+
+    b = PallasMeshBackend(batch_size=1 << 13, interpret=True)
+    nonce = bytes(range(60))  # two-block tail -> XLA mesh fallback
+    secret = b.search(nonce, 1, list(range(256)))
+    assert secret is not None
+    assert puzzle.check_secret(nonce, secret, 1)
+
+
+def test_pallas_mesh_warmup_covers_serving_compile_keys():
+    """After boot warmup, serving any pow2 partition must not compile a
+    new mesh-kernel program (the same layout-keyed discipline the XLA
+    mesh path proves in test_search.py)."""
+    from distpow_tpu.backends import PallasMeshBackend
+    from distpow_tpu.parallel.mesh_search import _dyn_pallas_mesh_step
+
+    b = PallasMeshBackend(batch_size=1 << 14, interpret=True)
+    b.warmup([3], [0, 1])
+    misses = _dyn_pallas_mesh_step.cache_info().misses
+    for tbs in (list(range(256)), list(range(128, 192))):
+        secret = b.search(b"\x07\x08\x09", 2, tbs)
+        assert secret is not None
+    assert _dyn_pallas_mesh_step.cache_info().misses == misses
+
+
 def test_pallas_mask_word_buckets_match_xla():
     # difficulties spanning all four trailing-word buckets exercise the
     # skipped-final-rounds DCE (mw=1 skips rounds 62-63, mw=2 skips 63)
