@@ -278,11 +278,16 @@ class PallasMeshBackend(JaxMeshBackend):
             log.info("pallas-mesh: %s; serving via the XLA mesh step", exc)
             return xla_factory
 
+        fell_back = []
+
         def factory(vw, extra, target_chunks, launch_steps=1):
             try:
                 return pallas_factory(vw, extra, target_chunks, launch_steps)
-            except ValueError:
-                # e.g. multi-block tail for this nonce length
+            except ValueError as exc:
+                if not fell_back:  # log once per request factory
+                    fell_back.append(True)
+                    log.info("pallas-mesh: %s; serving width %d via the "
+                             "XLA mesh step", exc, vw)
                 return xla_factory(vw, extra, target_chunks, launch_steps)
 
         return factory

@@ -371,6 +371,7 @@ class Worker:
             batch_size=config.BatchSize,
             mesh_devices=config.MeshDevices,
             max_launch=config.MaxLaunchCandidates or None,
+            interpret=getattr(config, "PallasInterpret", False),
         )
         self.handler = WorkerRPCHandler(
             self.tracer, self.result_queue, backend,

@@ -155,6 +155,31 @@ def _dyn_mesh_step(
     return jax.jit(sharded)
 
 
+def _width0_probe(nonce: bytes, difficulty: int, tb_lo: int, tbc: int,
+                  model: HashModel, extra: bytes):
+    """Width-0 factory result shared by every mesh factory: 256
+    candidates max — no mesh benefit; the single-device layout-keyed
+    probe is warmup-covered."""
+    return (
+        cached_search_step(
+            bytes(nonce), 0, difficulty, tb_lo, tbc, 1,
+            model.name, bytes(extra),
+        ),
+        1,
+    )
+
+
+def _chunk_split_budget(target_chunks: int, tbc: int, n_dev: int) -> int:
+    """Per-device chunk budget for the chunk-split regime, shared by the
+    XLA and pallas mesh factories: normalize to a multiple of 256 so
+    batch_local — the compile key — is independent of which pow2
+    tbc < n_dev the request carries (one warmed program serves every
+    small partition), and divide the global budget by n_dev so one
+    dispatch never covers n_dev x the configured launch volume."""
+    eb_local = max(256, (target_chunks * tbc // n_dev) // 256 * 256)
+    return max(1, eb_local // tbc)
+
+
 @functools.lru_cache(maxsize=None)
 def _dyn_pallas_mesh_step(
     mesh: Mesh,
@@ -299,24 +324,11 @@ def _pallas_mesh_step_factory(
 
     def factory(vw: int, extra: bytes, target_chunks: int, launch_steps: int = 1):
         if vw == 0:
-            # width-0 probe: single-device layout-keyed program
-            return (
-                cached_search_step(
-                    bytes(nonce), 0, difficulty, tb_lo, tbc, 1,
-                    model.name, bytes(extra),
-                ),
-                1,
-            )
+            return _width0_probe(nonce, difficulty, tb_lo, tbc, model, extra)
         if tb_split:
             chunks_local = max(1, target_chunks)
         else:
-            # chunk split: normalize the per-device budget by n_dev, as
-            # _mesh_step_factory does — otherwise each device gets the
-            # FULL effective batch and one dispatch covers n_dev x the
-            # configured launch budget (cancellation latency, overscan,
-            # and VMEM-resident work all inflate n_dev-fold)
-            eb_local = max(256, (target_chunks * tbc // n_dev) // 256 * 256)
-            chunks_local = max(1, eb_local // tbc)
+            chunks_local = _chunk_split_budget(target_chunks, tbc, n_dev)
         batch_local = chunks_local * tbl
         # round the per-device batch up to a whole tile grid
         if batch_local % tile:
@@ -429,27 +441,12 @@ def _mesh_step_factory(
 
     def factory(vw: int, extra: bytes, target_chunks: int, launch_steps: int = 1):
         if vw == 0:
-            # 256 candidates max — no mesh benefit; reuse the shared
-            # layout-keyed width-0 probe (single device, warmup-covered)
-            return (
-                cached_search_step(
-                    bytes(nonce), 0, difficulty, tb_lo, tbc, 1,
-                    model.name, bytes(extra),
-                ),
-                1,
-            )
+            return _width0_probe(nonce, difficulty, tb_lo, tbc, model, extra)
         if tb_split:
             # every device scans the same chunks on its own tb slice
             chunks_local = max(1, target_chunks)
         else:
-            # chunk split: normalize the per-device budget to a multiple
-            # of 256 so batch_local — the compile key — is independent of
-            # which pow2 tbc < n_dev the request carries; one warmed
-            # program then serves every small partition (target_chunks *
-            # tbc recovers effective_batch exactly: tbc | effective_batch
-            # because both are pow2-multiples of <=256)
-            eb_local = max(256, (target_chunks * tbc // n_dev) // 256 * 256)
-            chunks_local = max(1, eb_local // tbc)
+            chunks_local = _chunk_split_budget(target_chunks, tbc, n_dev)
         if pow2:
             k = max(1, launch_steps)
             step = bind_dyn(vw, bytes(extra), chunks_local, k)

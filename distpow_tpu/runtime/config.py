@@ -118,6 +118,11 @@ class WorkerConfig:
     JaxCoordinator: str = ""
     JaxNumProcesses: int = 1
     JaxProcessId: int = 0
+    # Dev-only: run the pallas/pallas-mesh kernels in interpret mode so
+    # kernel-backed workers can serve off-TPU (CI, the CPU mesh demo).
+    # Orders of magnitude slower than the XLA step on CPU — never set in
+    # production.
+    PallasInterpret: bool = False
 
 
 @dataclass

@@ -273,6 +273,23 @@ def test_failed_mine_does_not_leak_task_entry():
         s.close()
 
 
+def test_pallas_mesh_worker_serves_through_protocol():
+    """A worker with Backend=pallas-mesh (interpret mode off-TPU, the
+    PallasInterpret dev knob) serves a full Mine through the real RPC
+    protocol — the kernel mesh path integrated at the node layer."""
+    s = Stack(1, backend="pallas-mesh",
+              worker_extra={"BatchSize": 1 << 13,
+                            "PallasInterpret": True,
+                            "WarmupNonceLens": [], "WarmupWidths": []})
+    try:
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x6a\x6b", 2, timeout=240)
+        assert res.error is None
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+    finally:
+        s.close()
+
+
 def test_worker_compilation_cache_dir(tmp_path):
     """CompilationCacheDir persists XLA compiles across boots: after a
     jax-backend solve, the cache directory holds compiled programs."""
