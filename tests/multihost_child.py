@@ -32,16 +32,43 @@ assert len(jax.devices()) == 8, jax.devices()
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distpow_tpu.models import puzzle  # noqa: E402
-from distpow_tpu.parallel.mesh_search import make_mesh, search_mesh  # noqa: E402
+from distpow_tpu.models.registry import get_hash_model  # noqa: E402
+from distpow_tpu.parallel.mesh_search import (  # noqa: E402
+    _pallas_mesh_step_factory,
+    make_mesh,
+    search_mesh,
+)
 
 # nonce chosen so the FIRST solution in enumeration order is
 # (tb=214, chunk=empty->width probe) — tb 214 lives on global device
 # 214 // 32 = 6, owned by process 1 (tests/test_multihost.py verified
 # the oracle offline)
 NONCE = bytes.fromhex("045a")
-res = search_mesh(NONCE, 2, list(range(256)), mesh=make_mesh(jax.devices()),
-                  batch_size=1 << 12)
+mesh = make_mesh(jax.devices())
+res = search_mesh(NONCE, 2, list(range(256)), mesh=mesh, batch_size=1 << 12)
 assert res is not None
 assert puzzle.check_secret(NONCE, res.secret, 2)
 print(f"RESULT pid={pid} secret={res.secret.hex()} tb={res.thread_byte}",
       flush=True)
+
+# a solve through the pallas-mesh kernel factory (interpret mode on the
+# CPU mesh).  Different nonce on purpose: NONCE's first solution is
+# width-0 (empty chunk), which both factories serve via the shared
+# single-device probe — it would never consult the kernel.  0x000c has
+# NO width-0 solution and its first width-1 solution is (tb=144,
+# chunk=1) — verified against the hashlib oracle — so the result comes
+# from the KERNEL's tile grid, tb=144 lives on global device 4 (process
+# 1), and only the kernel's pmin-ed global flat index crossing the
+# process boundary can deliver it to process 0.
+NONCE_P = bytes.fromhex("000c")
+pf = _pallas_mesh_step_factory(
+    NONCE_P, 2, 0, 256, get_hash_model("md5"), mesh, "workers",
+    interpret=True,
+)
+res_p = search_mesh(NONCE_P, 2, list(range(256)), mesh=mesh,
+                    batch_size=1 << 12, step_factory=pf)
+assert res_p is not None
+assert puzzle.check_secret(NONCE_P, res_p.secret, 2)
+assert res_p.secret == bytes([144, 1]), res_p.secret.hex()
+print(f"PALLAS pid={pid} secret={res_p.secret.hex()} "
+      f"tb={res_p.thread_byte}", flush=True)

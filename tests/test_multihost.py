@@ -65,3 +65,11 @@ def test_two_process_mesh_solve_crosses_processes():
     # ...and it was found on process 1's devices (tb=214 -> device 6),
     # proving the pmin collective crossed the process boundary
     assert "tb=214" in results[0] and "tb=214" in results[1]
+    # the pallas-mesh kernel leg: nonce 0x000c's first solution (tb=144,
+    # chunk=1) comes from the kernel's tile grid on process 1's device 4
+    # — both processes reporting it proves the KERNEL's pmin-ed global
+    # flat index crossed the process boundary (the child also asserts
+    # the exact secret bytes against the oracle)
+    for out in outs:
+        pallas = [ln for ln in out.splitlines() if ln.startswith("PALLAS")]
+        assert len(pallas) == 1 and "tb=144" in pallas[0], out
