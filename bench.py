@@ -125,10 +125,51 @@ def measured_vpu_roofline(min_seconds: float = 2.0) -> float:
     return rate
 
 
-def main() -> None:
-    import jax
+def _device_alive(probe_timeout: int = 180) -> bool:
+    """Fail fast if the accelerator is unreachable.
 
-    print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
+    The tunneled TPU backend can go unresponsive for hours (observed
+    2026-07-29: ~21:10 onward); a bench run started then would hang in
+    the first dispatch FOREVER instead of failing.  The probe runs one
+    tiny op in a SUBPROCESS with a hard timeout — a hung backend blocks
+    inside C without returning to the interpreter, so in-process
+    SIGALRM handlers never fire (verified: an alarmed in-process probe
+    hung right through its deadline).
+    """
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "print(jax.devices());"
+             "assert int(jnp.uint32(2) + jnp.uint32(3)) == 5;"
+             "print('DEVICE_OK')"],
+            capture_output=True, text=True, timeout=probe_timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] accelerator unreachable: probe exceeded "
+              f"{probe_timeout}s", file=sys.stderr)
+        return False
+    if "DEVICE_OK" not in out.stdout:
+        print(f"[bench] accelerator probe failed: {out.stderr[-500:]}",
+              file=sys.stderr)
+        return False
+    for line in out.stdout.splitlines():
+        if line.startswith("["):
+            print(f"[bench] devices: {line}", file=sys.stderr)
+    return True
+
+
+def main() -> None:
+    if not _device_alive():
+        print(json.dumps({
+            "metric": "MH/s/chip md5 pow search (device unreachable)",
+            "value": 0.0,
+            "unit": "MH/s",
+            "vs_baseline": 0.0,
+        }))
+        return
 
     from distpow_tpu.models.registry import get_hash_model
     from distpow_tpu.ops.search_step import build_search_step, cached_search_step
