@@ -152,9 +152,13 @@ def _device_alive(probe_timeout: int = 180) -> bool:
               f"{probe_timeout}s", file=sys.stderr)
         return False
     if "DEVICE_OK" not in out.stdout:
-        print(f"[bench] accelerator probe failed: {out.stderr[-500:]}",
+        # a CRASHED probe (import error, broken install) is an
+        # environment regression, not a transient outage — fail loudly
+        # with a nonzero exit instead of logging a "successful" 0.0 run
+        print(f"[bench] probe crashed (rc={out.returncode}) — broken "
+              f"environment, not an outage: {out.stderr[-500:]}",
               file=sys.stderr)
-        return False
+        raise SystemExit(1)
     for line in out.stdout.splitlines():
         if line.startswith("["):
             print(f"[bench] devices: {line}", file=sys.stderr)
