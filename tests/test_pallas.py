@@ -113,6 +113,42 @@ def test_sha256_tile_matches_hashlib_all_buckets():
                 assert int(out[j]) == ref_words[j], (mw, j)
 
 
+def test_sha256_tile_randomized_batch_words():
+    """Property test: the tile function on BATCH-SHAPED message words
+    (the kernel's real operand shape, exercising the non-scalar branch
+    of the K+w fold) matches hashlib lane-for-lane across random
+    messages and every DCE bucket."""
+    import hashlib
+    import random
+    import struct
+
+    import numpy as np
+
+    from distpow_tpu.models.sha256_jax import SHA256_INIT
+    from distpow_tpu.ops.md5_pallas import _sha256_tile
+
+    rng = random.Random(42)
+    LANES_N = 16
+    msgs = [bytes(rng.randrange(256) for _ in range(rng.randrange(1, 56)))
+            for _ in range(LANES_N)]
+    # pad each to one block; words[j] becomes a (LANES_N,) array
+    blocks = []
+    for m in msgs:
+        tail = (m + b"\x80" + b"\x00" * (64 - len(m) - 9)
+                + struct.pack(">Q", len(m) * 8))
+        blocks.append(struct.unpack(">16I", tail))
+    words = [jnp.asarray(np.array([b[j] for b in blocks], np.uint32))
+             for j in range(16)]
+    init = [jnp.uint32(s) for s in SHA256_INIT]
+    refs = [struct.unpack(">8I", hashlib.sha256(m).digest()) for m in msgs]
+    for mw in (1, 3, 8):
+        out = _sha256_tile(words, init, mw)
+        for j in range(8 - mw, 8):
+            got = np.asarray(out[j])
+            for lane in range(LANES_N):
+                assert int(got[lane]) == refs[lane][j], (mw, j, lane)
+
+
 @pytest.mark.slow
 def test_sha256_pallas_kernel_matches_xla_step():
     """Full sha256 kernel in interpret mode (one compile ~80s on
