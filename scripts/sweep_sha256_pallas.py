@@ -1,0 +1,76 @@
+"""Sweep the SHA-256 Pallas kernel tile geometry on the real chip.
+
+Usage: python scripts/sweep_sha256_pallas.py [--quick]
+
+Measures candidates/sec for (sublanes, inner) combinations at the
+serving launch shape (width-4 chunks, full 256-byte partition,
+difficulty 8 nibbles) and prints a ranked table plus the XLA serving
+rate for reference.  Feed the winner back into
+``ops/md5_pallas.py MODEL_GEOMETRY['sha256']``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+from bench import device_rate  # noqa: E402  (the canonical timing harness)
+
+
+def rate_of(step_builder, label: str):
+    return device_rate(step_builder, label, min_seconds=1.5)
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    import jax
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+
+    from distpow_tpu.ops.md5_pallas import build_pallas_search_step
+    from distpow_tpu.ops.search_step import cached_search_step
+    from distpow_tpu.parallel.search import launch_steps_for
+
+    nonce = b"\x01\x02\x03\x04"
+    chunks = 8192
+    k = launch_steps_for(4, chunks, 256, 1 << 28)
+
+    def xla_builder():
+        step = cached_search_step(nonce, 4, 8, 0, 256, chunks, "sha256",
+                                  b"", k)
+        return step, chunks * 256 * k
+
+    xla = rate_of(xla_builder, "XLA serving reference")
+
+    sublanes_set = (8, 16) if quick else (8, 16, 24, 32)
+    inner_set = (512, 1024) if quick else (128, 256, 512, 1024, 2048)
+    results = []
+    for sl in sublanes_set:
+        for inner in inner_set:
+            try:
+                def builder(sl=sl, inner=inner):
+                    step = build_pallas_search_step(
+                        nonce, 4, 8, 0, 256, chunks, model_name="sha256",
+                        sublanes=sl, inner=inner, launch_steps=k,
+                    )
+                    return step, chunks * 256 * k
+
+                r = rate_of(builder, f"sublanes={sl} inner={inner}")
+                results.append((r, sl, inner))
+                print(f"  sublanes={sl:3d} inner={inner:5d}: "
+                      f"{r / 1e6:8.1f} MH/s ({r / xla:.2f}x XLA)")
+            except Exception as exc:
+                print(f"  sublanes={sl:3d} inner={inner:5d}: FAILED {exc}")
+
+    if results:
+        results.sort(reverse=True)
+        r, sl, inner = results[0]
+        print(f"\nbest: sublanes={sl} inner={inner} -> {r / 1e6:.1f} MH/s "
+              f"({r / xla:.2f}x the XLA serving step)")
+        print("update ops/md5_pallas.py MODEL_GEOMETRY['sha256'] = "
+              f"({sl}, {inner}) if this beats the current entry")
+
+
+if __name__ == "__main__":
+    main()
