@@ -381,6 +381,29 @@ def main() -> None:
         baseline = hashes.value / dt
         print(f"[bench] native 1-thread CPU baseline: "
               f"{baseline / 1e6:.2f} MH/s", file=sys.stderr)
+        # sha256 CPU baseline (algo=1): anchors the sha256 serving
+        # rate's vs-CPU ratio the way the md5 baseline anchors the
+        # headline.  Own try/except: a failure in this DIAGNOSTIC must
+        # not fall into the outer except and replace the already-valid
+        # md5 native baseline with the ~50x-slower hashlib fallback
+        # (which would inflate the headline vs_baseline).
+        try:
+            hashes_s = ctypes.c_uint64(0)
+            t0 = time.time()
+            lib.distpow_search_range(
+                nonce, len(nonce), 64, 1, tb, len(tb), 4, 1 << 24,
+                (1 << 20) // 256, 1, None, ctypes.byref(hashes_s), secret,
+            )
+            sha_base = hashes_s.value / (time.time() - t0)
+            print(f"[bench] native 1-thread sha256 CPU baseline: "
+                  f"{sha_base / 1e6:.2f} MH/s", file=sys.stderr)
+            if "sha256-serving" in rates and sha_base > 0:
+                print(f"[bench] sha256 serving vs 1-thread CPU: "
+                      f"{rates['sha256-serving'] / sha_base:.0f}x",
+                      file=sys.stderr)
+        except Exception as exc:
+            print(f"[bench] sha256 CPU baseline failed: {exc}",
+                  file=sys.stderr)
     except Exception as exc:
         print(f"[bench] native baseline unavailable ({exc}); "
               f"falling back to hashlib", file=sys.stderr)
