@@ -114,7 +114,9 @@ def test_multiprocess_demo_scenario(tmp_path):
         client = spawn("distpow_tpu.cli.client",
                        "--config", str(tmp_path / "client_config.json"),
                        "--config2", str(tmp_path / "client2_config.json"),
-                       "--difficulty", "2")
+                       # bits unit: 8 bits = 2 nibbles (exercises the
+                       # SURVEY §7 difficulty-unit translation end-to-end)
+                       "--difficulty-bits", "8")
         out, _ = client.communicate(timeout=120)
         assert client.returncode == 0, out
         assert out.count("MineResult") == 4, out
