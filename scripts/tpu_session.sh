@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# One uninterrupted TPU work session: waits for the device, then runs
+# (1) the quick sha256 kernel geometry sweep, (2) the full bench, and
+# (3) the config-5 process-level run — sequentially, in one process
+# tree, with NO kills in between (interrupting an active TPU client has
+# twice left the tunnel unresponsive for hours; see
+# docs/KERNELS.md + BASELINE.md provenance notes).
+# Usage: scripts/tpu_session.sh [outdir]   (default /tmp/tpu_session)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-/tmp/tpu_session}"
+mkdir -p "$OUT"
+
+echo "=== waiting for device ($(date +%T)) ===" | tee "$OUT/session.log"
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax, jax.numpy as jnp; assert int(jnp.uint32(2)+jnp.uint32(3))==5" 2>/dev/null; then
+    echo "device up at $(date +%T)" | tee -a "$OUT/session.log"
+    break
+  fi
+  sleep 90
+done
+
+echo "=== sha256 kernel sweep (quick) ===" | tee -a "$OUT/session.log"
+python scripts/sweep_sha256_pallas.py --quick >"$OUT/sweep.log" 2>&1
+tail -8 "$OUT/sweep.log" | tee -a "$OUT/session.log"
+
+echo "=== full bench ===" | tee -a "$OUT/session.log"
+python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
+cat "$OUT/bench.json" | tee -a "$OUT/session.log"
+
+echo "=== config-5 TPU-backed process run ===" | tee -a "$OUT/session.log"
+bash scripts/run_config5_tpu.sh 6 "$OUT/config5" >"$OUT/config5.log" 2>&1
+grep -E "MineResult|violation|wall-clock|warmup" "$OUT/config5.log" | tee -a "$OUT/session.log"
+
+echo "=== done $(date +%T) ===" | tee -a "$OUT/session.log"
