@@ -24,7 +24,7 @@ reference enumeration order, or None when cancelled.
 from __future__ import annotations
 
 import logging
-from typing import Callable, Optional, Sequence
+from typing import Optional, Sequence
 
 from ..models import puzzle
 from ..models.registry import get_hash_model

@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import math
 import struct
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax.numpy as jnp
 

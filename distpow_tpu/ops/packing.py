@@ -28,7 +28,7 @@ become batch-shaped arrays, the rest stay scalars that XLA constant-folds.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 import jax.numpy as jnp
 

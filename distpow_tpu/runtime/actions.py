@@ -26,8 +26,8 @@ DistributedClocks library is not vendored), so the readable form wins.
 
 from __future__ import annotations
 
-from dataclasses import asdict, dataclass, field, fields
-from typing import Dict, Optional, Tuple, Type
+from dataclasses import dataclass, fields
+from typing import Dict, Tuple, Type
 
 
 def _b(x) -> Tuple[int, ...]:

@@ -37,7 +37,7 @@ from __future__ import annotations
 import json
 import re
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 ACTION_RE = re.compile(
     r"^\[(?P<id>[^\]]+)\] TraceID=(?P<tid>\d+) (?P<action>[A-Za-z]\w*)"

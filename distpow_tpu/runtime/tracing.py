@@ -34,7 +34,7 @@ import socket
 import struct
 import threading
 import zlib
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from .actions import Action
 

@@ -13,13 +13,28 @@ OUT="${1:-/tmp/tpu_session}"
 mkdir -p "$OUT"
 
 echo "=== waiting for device ($(date +%T)) ===" | tee "$OUT/session.log"
+UP=0
 for i in $(seq 1 200); do
-  if timeout 90 python -c "import jax, jax.numpy as jnp; assert int(jnp.uint32(2)+jnp.uint32(3))==5" 2>/dev/null; then
+  timeout 150 python -c "import jax, jax.numpy as jnp; assert int(jnp.uint32(2)+jnp.uint32(3))==5" 2>"$OUT/probe.err"
+  RC=$?
+  if [ "$RC" -eq 0 ]; then
     echo "device up at $(date +%T)" | tee -a "$OUT/session.log"
+    UP=1
     break
+  elif [ "$RC" -ne 124 ] && [ "$RC" -ne 143 ]; then
+    # fast nonzero exit = broken environment (ImportError, bad venv),
+    # not an outage — looping for hours could never help
+    echo "probe CRASHED (rc=$RC) — broken environment, aborting:" \
+      | tee -a "$OUT/session.log"
+    tail -5 "$OUT/probe.err" | tee -a "$OUT/session.log"
+    exit 1
   fi
   sleep 90
 done
+if [ "$UP" -ne 1 ]; then
+  echo "device never appeared; aborting session" | tee -a "$OUT/session.log"
+  exit 1
+fi
 
 echo "=== sha256 kernel sweep (quick) ===" | tee -a "$OUT/session.log"
 python scripts/sweep_sha256_pallas.py --quick >"$OUT/sweep.log" 2>&1
