@@ -242,9 +242,9 @@ def main() -> None:
     except Exception as exc:
         print(f"[bench] sha256 serving bench failed: {exc}", file=sys.stderr)
 
-    # SHA-256 Pallas kernel (round 3): explicit sublanes=8 tile geometry
-    # to dodge the register spills capping the XLA fusion at ~77% of the
-    # measured roofline (docs/KERNELS.md)
+    # SHA-256 Pallas kernel (round 3): explicit tile geometry (swept
+    # MODEL_GEOMETRY default) to dodge the register spills capping the
+    # XLA fusion at ~77% of the measured roofline (docs/KERNELS.md)
     try:
         from distpow_tpu.ops.md5_pallas import build_pallas_search_step as _bps
 

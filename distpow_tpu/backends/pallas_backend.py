@@ -1,10 +1,11 @@
-"""Worker backend driving the Pallas MD5 kernel through the search loop.
+"""Worker backend driving the Pallas hash kernels through the search loop.
 
-Plugs ``ops.md5_pallas`` into ``parallel.search`` via the step-factory
-protocol.  Launch geometry: the batch is rounded to a whole number of
-(sublanes, 128) tiles; configurations the kernel cannot express
-(non-power-of-two thread-byte runs, multi-block tails, non-MD5 models)
-fall back to the fused XLA step transparently.
+Plugs ``ops.md5_pallas`` (MD5 and SHA-256 kernels, each with a
+hardware-swept tile geometry) into ``parallel.search`` via the
+step-factory protocol.  Launch geometry: the batch is rounded to a
+whole number of (sublanes, 128) tiles; configurations the kernel cannot
+express (non-power-of-two thread-byte runs, multi-block tails, models
+without a kernel) fall back to the fused XLA step transparently.
 """
 
 from __future__ import annotations
@@ -14,8 +15,8 @@ from typing import Optional
 from ..models.registry import get_hash_model
 from ..ops.md5_pallas import (
     LANES,
-    MODEL_GEOMETRY,
     cached_pallas_search_step,
+    default_geometry,
 )
 from ..ops.search_step import cached_search_step
 from ..parallel.search import contiguous_bounds, search
@@ -39,10 +40,10 @@ class PallasBackend:
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
         # per-model tuned tile geometry unless explicitly overridden
-        # (models without a tuned entry get md5's; the kernel builder
-        # rejects unimplemented models before the geometry matters)
-        default_geom = MODEL_GEOMETRY.get(self.model.name,
-                                          MODEL_GEOMETRY["md5"])
+        # (default_geometry caps interpret-mode sublanes at 8 — the
+        # serving geometry's interpret compile is pathological on
+        # XLA:CPU, see its docstring)
+        default_geom = default_geometry(self.model.name, interpret)
         self.sublanes = sublanes if sublanes is not None else default_geom[0]
         self.inner = inner if inner is not None else default_geom[1]
         self.interpret = interpret

@@ -35,8 +35,11 @@ geometry.  Unlike MD5, where the kernel only matched XLA, SHA-256 is
 where explicit geometry should PAY: the unrolled XLA step compiles to
 one loop fusion but runs at ~77% of the measured VPU roofline
 (BENCH round 3) — consistent with register spills from the ~24-value
-live set (16-word schedule window + 8 working vars).  The kernel pins
-sublanes=8 so each live value is a single (8, 128) vreg.  The tile
+live set (16-word schedule window + 8 working vars).  And it does pay:
+the round-3 hardware sweep measured the kernel at 1.3x the XLA serving
+step, ~99% of the measured roofline, at sublanes=16 (see
+MODEL_GEOMETRY; the one-vreg-per-live-value sublanes=8 guess lost to
+per-tile fixed cost).  The tile
 function uses the functional A/E form (a_r/e_r sequences instead of the
 8-var shuffle), which makes the difficulty-bucket dead-code elimination
 exact: digest word j reads A[63-j] (j<4) or E[67-j] (j>=4), so for the
@@ -68,11 +71,31 @@ LANES = 128
 # (taller tiles spill — 256 sublanes measured ~25% slower), the inner
 # loop amortizes per-grid-step fixed cost (TPU v5e sweep, BENCH_r02:
 # ~10.0 GH/s at (64, 512) vs 2.34 GH/s for round 1's flat (256,) grid;
-# inner auto-shrinks to divide smaller launches).  SHA-256's ~24-value
-# live set needs each value to be ONE (8, 128) vreg or the round chain
-# spills.
-MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (8, 1024)}
+# inner auto-shrinks to divide smaller launches).  SHA-256: the round-3
+# hardware sweep (scripts/sweep_sha256_pallas.py, TPU v5e) measured
+# (16, *) at 1954 MH/s vs (8, *) at 1298 — two vregs per live value
+# beats one; at sublanes=8 the per-tile fixed cost (iota, hit
+# accumulation) is amortized over half as many candidates and dominates.
+MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (16, 1024)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
+
+
+def default_geometry(model_name: str, interpret: bool = False):
+    """Resolve the (sublanes, inner) geometry for a kernel launch.
+
+    Serving uses the model's hardware-swept MODEL_GEOMETRY entry (models
+    without one get md5's; the kernel builder rejects unimplemented
+    models before geometry matters).  Interpret mode — the off-TPU dev
+    knob — caps sublanes at 8: kernel semantics are geometry-
+    independent, and XLA:CPU's codegen on the interpreted sha256 tile
+    is superlinear in tile height (the (16, 128) serving geometry
+    compiles for ~20 min where (8, 128) takes ~3).  Every sublane
+    resolution site (the builder, PallasBackend, the pallas-mesh step
+    factory) goes through here so the cap cannot be bypassed by a
+    caller resolving geometry itself.
+    """
+    geom = MODEL_GEOMETRY.get(model_name, MODEL_GEOMETRY["md5"])
+    return (min(geom[0], 8), geom[1]) if interpret else geom
 
 
 def _rotl(x, s: int):
@@ -363,17 +386,19 @@ def build_pallas_search_step(
     configuration); callers fall back to the XLA path otherwise.
 
     ``sublanes``/``inner`` default to the model's tuned geometry
-    (MODEL_GEOMETRY); pass explicitly to sweep.
+    (``default_geometry``, which caps interpret-mode sublanes at 8 —
+    see its docstring); pass explicitly to sweep.
     """
     model = get_hash_model(model_name)
     if model.name not in _TILE_FNS:
         raise ValueError(
             f"pallas kernel implements {sorted(_TILE_FNS)}, not {model.name}"
         )
+    geom = default_geometry(model.name, interpret)
     if sublanes is None:
-        sublanes = MODEL_GEOMETRY[model.name][0]
+        sublanes = geom[0]
     if inner is None:
-        inner = MODEL_GEOMETRY[model.name][1]
+        inner = geom[1]
     if tb_count & (tb_count - 1):
         raise ValueError("pallas kernel requires power-of-two tb_count")
 

@@ -277,7 +277,7 @@ def _pallas_mesh_step_factory(
     without a kernel); ``PallasMeshBackend`` catches these per width and
     falls back to the XLA mesh factory transparently.
     """
-    from ..ops.md5_pallas import LANES, MODEL_GEOMETRY
+    from ..ops.md5_pallas import LANES, MODEL_GEOMETRY, default_geometry
 
     n_dev = int(mesh.devices.size)
     if n_dev & (n_dev - 1):
@@ -286,10 +286,11 @@ def _pallas_mesh_step_factory(
         raise ValueError("pallas kernel requires power-of-two tb_count")
     if model.name not in MODEL_GEOMETRY:
         raise ValueError(f"no pallas kernel for model {model.name}")
+    geom = default_geometry(model.name, interpret)
     if sublanes is None:
-        sublanes = MODEL_GEOMETRY[model.name][0]
+        sublanes = geom[0]
     if inner is None:
-        inner = MODEL_GEOMETRY[model.name][1]
+        inner = geom[1]
     tile = sublanes * LANES
     tb_split = tbc >= n_dev and tbc % n_dev == 0
     log_ndev = n_dev.bit_length() - 1

@@ -43,7 +43,10 @@ def main() -> None:
 
     xla = rate_of(xla_builder, "XLA serving reference")
 
-    sublanes_set = (8, 16) if quick else (8, 16, 24, 32)
+    # no 24: serving batches are powers of two, never divisible by the
+    # 24*128 tile — the kernel builder rejects it, so it isn't a
+    # shippable geometry (and would only print FAILED here)
+    sublanes_set = (8, 16) if quick else (8, 16, 32)
     inner_set = (512, 1024) if quick else (128, 256, 512, 1024, 2048)
     results = []
     for sl in sublanes_set:

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # One uninterrupted TPU work session: waits for the device, then runs
-# (1) the quick sha256 kernel geometry sweep, (2) the full bench, and
-# (3) the config-5 process-level run — sequentially, in one process
+# (1) the full bench, (2) the config-5 process-level run, and (3) the
+# full sha256 kernel geometry sweep — sequentially, in one process
 # tree, with NO kills in between (interrupting an active TPU client has
 # twice left the tunnel unresponsive for hours; see
 # docs/KERNELS.md + BASELINE.md provenance notes).
@@ -36,10 +36,10 @@ if [ "$UP" -ne 1 ]; then
   exit 1
 fi
 
-echo "=== sha256 kernel sweep (quick) ===" | tee -a "$OUT/session.log"
-python scripts/sweep_sha256_pallas.py --quick >"$OUT/sweep.log" 2>&1
-tail -8 "$OUT/sweep.log" | tee -a "$OUT/session.log"
-
+# Stage order = value per TPU-minute: the headline bench first (the
+# 2026-07-29/30 outages both struck mid-session; whatever runs first is
+# whatever gets measured), then the process-level config-5 drive, then
+# the open-ended geometry sweep last.
 echo "=== full bench ===" | tee -a "$OUT/session.log"
 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
 cat "$OUT/bench.json" | tee -a "$OUT/session.log"
@@ -47,5 +47,9 @@ cat "$OUT/bench.json" | tee -a "$OUT/session.log"
 echo "=== config-5 TPU-backed process run ===" | tee -a "$OUT/session.log"
 bash scripts/run_config5_tpu.sh 6 "$OUT/config5" >"$OUT/config5.log" 2>&1
 grep -E "MineResult|violation|wall-clock|warmup" "$OUT/config5.log" | tee -a "$OUT/session.log"
+
+echo "=== sha256 kernel sweep (full) ===" | tee -a "$OUT/session.log"
+python scripts/sweep_sha256_pallas.py >"$OUT/sweep.log" 2>&1
+tail -12 "$OUT/sweep.log" | tee -a "$OUT/session.log"
 
 echo "=== done $(date +%T) ===" | tee -a "$OUT/session.log"
