@@ -353,6 +353,10 @@ def _pallas_mesh_step_factory(
                          else chunks_local * n_dev) * k
         return step, global_chunks
 
+    # resolved geometry, exposed so tests can pin the interpret-mode
+    # sublanes cap at this site (default_geometry's third caller)
+    factory.sublanes = sublanes
+    factory.inner = inner
     return factory
 
 

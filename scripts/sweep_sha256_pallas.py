@@ -43,21 +43,31 @@ def main() -> None:
 
     xla = rate_of(xla_builder, "XLA serving reference")
 
-    # no 24: serving batches are powers of two, never divisible by the
-    # 24*128 tile — the kernel builder rejects it, so it isn't a
-    # shippable geometry (and would only print FAILED here)
-    sublanes_set = (8, 16) if quick else (8, 16, 32)
+    sublanes_set = (8, 16) if quick else (8, 16, 24, 32)
     inner_set = (512, 1024) if quick else (128, 256, 512, 1024, 2048)
     results = []
     for sl in sublanes_set:
+        # batch must be a whole number of (sl, 128) tiles: chunks*256 %
+        # (sl*128) == 0 <=> 2*chunks % sl == 0.  The pow2 default fails
+        # that only for sl=24 (the serving backends would round such a
+        # batch up; here we grow chunks to the next multiple so the
+        # geometry is measured at an aligned shape: 12288*256 = 1024
+        # tiles of 3072).  Rates are per-candidate, so differing chunk
+        # counts stay comparable.
+        chunks_sl = chunks
+        while (2 * chunks_sl) % sl:
+            chunks_sl += chunks // 2
+        k_sl = launch_steps_for(4, chunks_sl, 256, 1 << 28)
         for inner in inner_set:
             try:
-                def builder(sl=sl, inner=inner):
+                def builder(sl=sl, inner=inner, chunks_sl=chunks_sl,
+                            k_sl=k_sl):
                     step = build_pallas_search_step(
-                        nonce, 4, 8, 0, 256, chunks, model_name="sha256",
-                        sublanes=sl, inner=inner, launch_steps=k,
+                        nonce, 4, 8, 0, 256, chunks_sl,
+                        model_name="sha256",
+                        sublanes=sl, inner=inner, launch_steps=k_sl,
                     )
-                    return step, chunks * 256 * k
+                    return step, chunks_sl * 256 * k_sl
 
                 r = rate_of(builder, f"sublanes={sl} inner={inner}")
                 results.append((r, sl, inner))

@@ -43,6 +43,41 @@ def test_pallas_rejects_unsupported_configs():
         build_pallas_search_step(bytes(60), 4, 2, 0, 256, 128, interpret=True)
 
 
+def test_default_geometry_resolution_at_every_site():
+    """The interpret-mode sublanes cap must hold at every resolution
+    site (ops/md5_pallas.py default_geometry): serving gets the swept
+    MODEL_GEOMETRY entry, interpret mode is capped at 8 (the serving
+    geometry's interpret compile is pathological on XLA:CPU), and an
+    explicit override always wins."""
+    from distpow_tpu.ops.md5_pallas import MODEL_GEOMETRY, default_geometry
+
+    assert default_geometry("sha256") == MODEL_GEOMETRY["sha256"]
+    assert default_geometry("sha256", interpret=True)[0] == 8
+    assert default_geometry("md5", interpret=True)[0] == 8
+    # PallasBackend resolves through the same helper
+    assert PallasBackend(hash_model="sha256").sublanes == \
+        MODEL_GEOMETRY["sha256"][0]
+    assert PallasBackend(hash_model="sha256", interpret=True).sublanes == 8
+    assert PallasBackend(hash_model="sha256", interpret=True,
+                         sublanes=16).sublanes == 16
+    # ...and so does the pallas-mesh step factory (the third site)
+    import jax
+    from distpow_tpu.models.registry import SHA256
+    from distpow_tpu.parallel.mesh_search import (
+        AXIS,
+        _pallas_mesh_step_factory,
+        make_mesh,
+    )
+
+    mesh = make_mesh(jax.devices()[:8])
+    f_serve = _pallas_mesh_step_factory(
+        b"\x01", 8, 0, 256, SHA256, mesh, AXIS)
+    f_interp = _pallas_mesh_step_factory(
+        b"\x01", 8, 0, 256, SHA256, mesh, AXIS, interpret=True)
+    assert f_serve.sublanes == MODEL_GEOMETRY["sha256"][0]
+    assert f_interp.sublanes == 8
+
+
 def test_pallas_backend_end_to_end():
     backend = PallasBackend(batch_size=1 << 15, sublanes=8, interpret=True)
     nonce = b"\x0a\x0b\x0c"
