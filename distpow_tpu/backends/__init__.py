@@ -66,11 +66,16 @@ class PythonBackend:
 def _warm_factory(factory, widths, target_chunks, tbc, max_launch) -> None:
     """Compile-and-dispatch each width's step once (tiny real launch)."""
     from ..parallel.search import launch_steps_for
+    from ..runtime.watchdog import WATCHDOG
 
-    for vw in widths:
-        k = launch_steps_for(int(vw), target_chunks, tbc, max_launch)
-        step, _ = factory(int(vw), b"", target_chunks, k)
-        int(step(1))  # block_until_ready via the int() conversion
+    # one beat per compiled program: the watchdog timeout needs to
+    # exceed one compile, not the whole warmup pass
+    with WATCHDOG.active():
+        for vw in widths:
+            WATCHDOG.beat()
+            k = launch_steps_for(int(vw), target_chunks, tbc, max_launch)
+            step, _ = factory(int(vw), b"", target_chunks, k)
+            int(step(1))  # block_until_ready via the int() conversion
 
 
 # One representative difficulty per mask-word compile bucket

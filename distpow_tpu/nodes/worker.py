@@ -345,6 +345,7 @@ class Worker:
 
     def __init__(self, config: WorkerConfig, sink=None):
         self.config = config
+        self._armed_watchdog = False
         maybe_init_distributed(
             getattr(config, "JaxCoordinator", ""),
             getattr(config, "JaxNumProcesses", 1),
@@ -382,6 +383,23 @@ class Worker:
         self.bound_addr: Optional[str] = None
         self._forwarder: Optional[threading.Thread] = None
         self._stopping = threading.Event()
+        hang_timeout = float(getattr(config, "DeviceHangTimeoutS", 0.0) or 0.0)
+        if hang_timeout > 0:
+            # a hung accelerator dispatch makes this worker a zombie the
+            # coordinator's liveness probes cannot see through; the
+            # watchdog converts it into a visible death (and shard
+            # reassignment under FailurePolicy="reassign") —
+            # runtime/watchdog.py.  Refcounted: in-process multi-worker
+            # harnesses share one clock (first timeout wins), and it
+            # stops when the last armed worker shuts down.  Armed LAST,
+            # after every fallible constructor step: an init failure
+            # must not leak a ref the matching shutdown() will never
+            # release (and nothing earlier runs inside an active()
+            # section, so arming earlier would protect nothing).
+            from ..runtime.watchdog import WATCHDOG
+
+            WATCHDOG.acquire(hang_timeout)
+            self._armed_watchdog = True
         self._start_warmup(backend)
 
     def _start_warmup(self, backend) -> None:
@@ -463,9 +481,21 @@ class Worker:
         threading.Event().wait()
 
     def shutdown(self) -> None:
-        self._stopping.set()
-        self.result_queue.put(None)
-        self.server.shutdown()
-        self.coordinator.close()
-        self.handler.result_cache.close()
-        self.tracer.close()
+        try:
+            self._stopping.set()
+            self.result_queue.put(None)
+            self.server.shutdown()
+            self.coordinator.close()
+            self.handler.result_cache.close()
+            self.tracer.close()
+        finally:
+            if self._armed_watchdog:
+                # last armed worker out stops the clock, so it cannot
+                # govern unrelated later searches in the process — nor
+                # vanish while other armed workers still serve
+                # (refcount).  In a finally: a close() failure above
+                # must not leak the ref.
+                from ..runtime.watchdog import WATCHDOG
+
+                WATCHDOG.release()
+                self._armed_watchdog = False

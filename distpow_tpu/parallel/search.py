@@ -43,6 +43,7 @@ from ..models import puzzle
 from ..models.registry import HashModel, get_hash_model
 from ..ops.search_step import SENTINEL, cached_search_step
 from ..runtime.metrics import REGISTRY as metrics
+from ..runtime.watchdog import WATCHDOG
 
 DEFAULT_BATCH = 1 << 20
 DEFAULT_PIPELINE_DEPTH = 2
@@ -191,6 +192,9 @@ def search(
         # instead of burning the device.
         import time
 
+        # (no watchdog involvement: this loop never touches the device,
+        # and beating here could mask a genuinely hung concurrent search
+        # on the shared staleness clock)
         while True:
             if cancel_check is not None and cancel_check():
                 return None
@@ -208,6 +212,7 @@ def search(
 
     def drain_one() -> Optional[SearchResult]:
         nonlocal hashes
+        WATCHDOG.beat()  # about to block on a device result fetch
         res, chunk0, vw, extra, n_cand = inflight.popleft()
         hashes += n_cand
         metrics.inc("search.hashes", n_cand)
@@ -236,32 +241,39 @@ def search(
                 return found
         return None
 
-    for width in range(0, max_width + 1):
-        for vw, lo, hi, extra in width_segments(width):
-            k = launch_steps_for(vw, target_chunks, tbc, launch_candidates)
-            step, chunks_per_step = factory(vw, extra, target_chunks, k)
-            n_cand = chunks_per_step * tbc
-            chunk0 = lo
-            while chunk0 < hi:
-                if cancel_check is not None and cancel_check():
-                    metrics.inc("search.cancelled")
-                    return None
-                if max_hashes is not None and hashes >= max_hashes:
-                    found = drain_all()
-                    if found is not None:
-                        metrics.inc("search.found")
-                    return found
-                res = step(chunk0 & 0xFFFFFFFF)
-                metrics.inc("search.launches")
-                inflight.append((res, chunk0, vw, extra, n_cand))
-                chunk0 += chunks_per_step
-                if len(inflight) >= pipeline_depth:
-                    found = drain_one()
-                    if found is not None:
-                        metrics.inc("search.found")
+    # The active() window covers every dispatch and drain: if the device
+    # hangs mid-search, beats stop and the watchdog (if the worker
+    # enabled it — WorkerConfig.DeviceHangTimeoutS) converts the zombie
+    # into a visible process death (runtime/watchdog.py).
+    with WATCHDOG.active():
+        for width in range(0, max_width + 1):
+            for vw, lo, hi, extra in width_segments(width):
+                WATCHDOG.beat()  # factory may compile (bounded, legit gap)
+                k = launch_steps_for(vw, target_chunks, tbc, launch_candidates)
+                step, chunks_per_step = factory(vw, extra, target_chunks, k)
+                n_cand = chunks_per_step * tbc
+                chunk0 = lo
+                while chunk0 < hi:
+                    WATCHDOG.beat()
+                    if cancel_check is not None and cancel_check():
+                        metrics.inc("search.cancelled")
+                        return None
+                    if max_hashes is not None and hashes >= max_hashes:
+                        found = drain_all()
+                        if found is not None:
+                            metrics.inc("search.found")
                         return found
-            found = drain_all()
-            if found is not None:
-                metrics.inc("search.found")
-                return found
+                    res = step(chunk0 & 0xFFFFFFFF)
+                    metrics.inc("search.launches")
+                    inflight.append((res, chunk0, vw, extra, n_cand))
+                    chunk0 += chunks_per_step
+                    if len(inflight) >= pipeline_depth:
+                        found = drain_one()
+                        if found is not None:
+                            metrics.inc("search.found")
+                            return found
+                found = drain_all()
+                if found is not None:
+                    metrics.inc("search.found")
+                    return found
     return None

@@ -110,6 +110,15 @@ class WorkerConfig:
     # (~10-12s for the full width set on TPU) are paid once per machine
     # instead of once per boot.  Empty = no persistent cache.
     CompilationCacheDir: str = ""
+    # Device-hang watchdog (runtime/watchdog.py): if a device-driving
+    # section (search launch/drain, a warmup compile) makes no progress
+    # for this many seconds, the worker exits with a distinctive code
+    # (EXIT_CODE 43) so the coordinator's FailurePolicy="reassign" can
+    # redirect its shards — a hung accelerator dispatch otherwise leaves
+    # a zombie that still answers liveness probes.  Must exceed the
+    # worst-case single compile (20-60s cold), not one launch; 300 is a
+    # conservative floor.  0 = disabled (reference parity).
+    DeviceHangTimeoutS: float = 0.0
     # Multi-host mesh: when JaxCoordinator is set,
     # jax.distributed.initialize runs before the backend is built, so a
     # jax-mesh worker's shard_map spans every chip of a multi-host slice

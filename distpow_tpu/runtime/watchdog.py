@@ -1,0 +1,186 @@
+"""Device-hang watchdog: turn a wedged accelerator into a clean worker death.
+
+The tunneled-TPU failure mode observed in practice (BASELINE.md, round-3
+measurement provenance) is a device dispatch that never returns: the
+in-flight result fetch blocks forever in an uninterruptible C call, and
+the worker becomes a zombie — its RPC threads still answer liveness
+probes (``Ping``), so the coordinator's ``FailurePolicy: "reassign"``
+(nodes/coordinator.py) never triggers, and the Mine task simply never
+completes.  The Go reference has no analogue (``md5.Sum`` cannot hang,
+worker.go:353), so this subsystem is config-gated and OFF by default
+(reference parity).
+
+Mechanism: compute paths that drive the device wrap themselves in
+``WATCHDOG.active()`` and call ``WATCHDOG.beat()`` at every host-side
+sync point — between launches in the search driver
+(parallel/search.py), between compile-and-dispatch steps in boot warmup
+(backends._warm_factory).  A daemon monitor thread fires when an
+*active* section goes ``timeout`` seconds without a beat.  Python
+cannot cancel the hung call, so the default action is ``os._exit``
+with a distinctive code: dying visibly is the one move that converts
+an undetectable zombie into an RPC failure the coordinator's
+reassignment path already handles.  A process supervisor restarting
+the worker completes the recovery loop.
+
+Sizing the timeout: it must exceed the worst-case single legitimate
+gap between beats — one XLA/Mosaic compile (20-60 s cold; warmup and
+serving beat once per compiled program, not once per warmup pass) —
+NOT one launch (~0.1-0.2 s).  300 s is a conservative floor; the
+config comment on ``WorkerConfig.DeviceHangTimeoutS`` repeats this.
+
+Beats cost two attribute reads and a ``time.monotonic()`` call and are
+no-ops while the watchdog is not started, so the instrumented paths pay
+nothing in the default configuration.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from contextlib import contextmanager
+from time import monotonic
+from typing import Callable, Optional
+
+log = logging.getLogger("distpow.watchdog")
+
+# Distinctive exit code so supervisors / tests can tell a watchdog death
+# from a crash.  (Avoids the 128+signal range and small shell codes.)
+EXIT_CODE = 43
+
+
+class DeviceWatchdog:
+    """Monitor for device-driving sections that stop making progress.
+
+    One instance (the module-level ``WATCHDOG``) is shared process-wide:
+    a worker owns one device, so if any dispatch hangs, every search on
+    the device is stuck — a single staleness clock is the right model.
+    The corollary (documented limitation): beats from a *live* search
+    can mask a hung one in the same process; detection then happens as
+    soon as the live search drains.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._active = 0
+        self._last_beat = 0.0
+        self._timeout = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._on_hang: Optional[Callable[[float], None]] = None
+        self._arm_lock = threading.Lock()  # serializes acquire/release
+        self._refs = 0  # acquire/release co-owners
+        self.fired = threading.Event()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self, timeout_s: float,
+              on_hang: Optional[Callable[[float], None]] = None) -> None:
+        """Start the monitor.  ``on_hang(stale_seconds)`` overrides the
+        default die-by-``os._exit(EXIT_CODE)`` action (tests use this)."""
+        if timeout_s <= 0:
+            raise ValueError("watchdog timeout must be positive")
+        with self._lock:
+            if self.running:
+                raise RuntimeError("watchdog already running")
+            self._timeout = float(timeout_s)
+            self._on_hang = on_hang
+            self._last_beat = monotonic()
+            self._stop.clear()
+            self.fired.clear()
+            self._thread = threading.Thread(
+                target=self._monitor, name="device-watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        with self._lock:
+            self._thread = None
+            # _active is deliberately NOT reset: sections still inside
+            # active() will run their paired decrements when they
+            # unwind; zeroing here would drive the counter negative and
+            # permanently blind a re-armed watchdog
+
+    def acquire(self, timeout_s: float) -> None:
+        """Refcounted arming for co-owners (one per in-process worker):
+        the first acquire starts the monitor, later ones share it (the
+        first timeout wins — one device, one staleness clock), and the
+        matching ``release`` of the last owner stops it."""
+        with self._arm_lock:
+            self._refs += 1
+            if not self.running:
+                self.start(timeout_s)
+                log.info("device-hang watchdog armed (timeout %gs)",
+                         timeout_s)
+            elif self._timeout != timeout_s:
+                log.warning(
+                    "device-hang watchdog already armed at %gs; ignoring "
+                    "requested timeout %gs (one clock per process)",
+                    self._timeout, timeout_s,
+                )
+
+    def release(self) -> None:
+        with self._arm_lock:
+            self._refs = max(0, self._refs - 1)
+            if self._refs == 0:
+                self.stop()
+
+    def beat(self) -> None:
+        if self._thread is None:
+            return
+        self._last_beat = monotonic()
+
+    @contextmanager
+    def active(self):
+        """Mark the enclosing block as device-driving.  Nestable and
+        concurrency-safe (a counter, not a flag)."""
+        if self._thread is None:
+            yield
+            return
+        with self._lock:
+            self._active += 1
+            self._last_beat = monotonic()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._active -= 1
+
+    def _monitor(self) -> None:
+        poll = min(1.0, self._timeout / 4)
+        while not self._stop.wait(poll):
+            if self._active <= 0:
+                # idle: nothing is driving the device; keep the clock
+                # fresh so the first beat of the next section starts a
+                # clean window
+                self._last_beat = monotonic()
+                continue
+            stale = monotonic() - self._last_beat
+            if stale > self._timeout:
+                log.critical(
+                    "device watchdog: %d active device section(s) made no "
+                    "progress for %.1fs (timeout %.1fs) — the accelerator "
+                    "dispatch is presumed hung; exiting so the coordinator "
+                    "can reassign this worker's shards",
+                    self._active, stale, self._timeout,
+                )
+                if self._on_hang is not None:
+                    # callback first, THEN the observable event: waiters
+                    # on ``fired`` may assert on the callback's effects
+                    self._on_hang(stale)
+                    self.fired.set()
+                    return
+                self.fired.set()
+                # Flush logs before the hard exit (os._exit skips
+                # atexit/finally by design: the process state is wedged).
+                logging.shutdown()
+                os._exit(EXIT_CODE)
+
+
+WATCHDOG = DeviceWatchdog()

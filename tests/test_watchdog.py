@@ -1,0 +1,155 @@
+"""Device-hang watchdog tests (runtime/watchdog.py).
+
+The watchdog exists for a failure mode the Go reference cannot have: an
+accelerator dispatch that never returns leaves a worker answering
+liveness probes while its Mine task never completes (BASELINE.md
+round-3 provenance documents the real outages that motivated it).
+These tests cover the monitor itself, the search-driver
+instrumentation, and the WorkerConfig plumbing.
+"""
+
+import threading
+import time
+
+import pytest
+
+from distpow_tpu.runtime.watchdog import WATCHDOG, DeviceWatchdog
+
+
+@pytest.fixture
+def dog():
+    d = DeviceWatchdog()
+    yield d
+    d.stop()
+
+
+def test_fires_on_stale_active_section(dog):
+    fired = []
+    dog.start(0.2, on_hang=fired.append)
+    with dog.active():
+        assert dog.fired.wait(2.0), "no fire despite stale active section"
+    assert fired and fired[0] >= 0.2
+
+
+def test_beats_keep_active_section_alive(dog):
+    dog.start(0.3, on_hang=lambda s: None)
+    with dog.active():
+        for _ in range(6):
+            time.sleep(0.1)
+            dog.beat()
+    assert not dog.fired.is_set()
+
+
+def test_idle_never_fires(dog):
+    # active == 0: nothing drives the device, staleness is meaningless
+    dog.start(0.2, on_hang=lambda s: None)
+    time.sleep(0.7)
+    assert not dog.fired.is_set()
+
+
+def test_noop_when_not_started(dog):
+    dog.beat()
+    with dog.active():
+        pass
+    assert not dog.running
+
+
+def test_rejects_bad_timeout(dog):
+    with pytest.raises(ValueError):
+        dog.start(0)
+
+
+def test_search_driver_hang_detected():
+    """A device fetch that never returns must trip the watchdog through
+    parallel.search's own instrumentation (the beat in drain_one)."""
+    from distpow_tpu.ops.search_step import SENTINEL
+    from distpow_tpu.parallel.search import search
+
+    unblock = threading.Event()
+
+    def factory(vw, extra, target_chunks, launch_steps=1):
+        def step(chunk0):
+            class HungResult:
+                def __int__(self):  # a device_get that never completes
+                    unblock.wait()
+                    return SENTINEL  # a miss, so the released thread
+                    # drains cleanly instead of fabricating a hit
+
+            return HungResult()
+
+        return step, max(1, target_chunks)
+
+    WATCHDOG.start(0.3, on_hang=lambda s: None)
+    try:
+        t = threading.Thread(
+            target=lambda: search(
+                b"\x01", 2, list(range(256)), step_factory=factory,
+                pipeline_depth=1, batch_size=1 << 10,
+                cancel_check=unblock.is_set,
+            ),
+            daemon=True,
+        )
+        t.start()
+        assert WATCHDOG.fired.wait(3.0), \
+            "watchdog did not detect the hung drain"
+    finally:
+        unblock.set()  # release the blocked thread before stopping
+        t.join(timeout=5.0)
+        WATCHDOG.stop()
+
+
+def test_acquire_release_refcount(dog):
+    dog.acquire(5.0)
+    dog.acquire(9.0)  # shared; first timeout wins
+    assert dog.running and dog._timeout == 5.0
+    dog.release()
+    assert dog.running, "watchdog stopped while a co-owner remains"
+    dog.release()
+    assert not dog.running
+
+
+def test_stop_with_hung_section_does_not_blind_rearm(dog):
+    """A section still stuck inside active() across a stop/start cycle
+    must not skew the counter and disable a re-armed watchdog."""
+    entered, unblock = threading.Event(), threading.Event()
+
+    def hung_section():
+        with dog.active():
+            entered.set()
+            unblock.wait()
+
+    dog.start(5.0, on_hang=lambda s: None)
+    t = threading.Thread(target=hung_section, daemon=True)
+    t.start()
+    assert entered.wait(2.0)
+    dog.stop()          # section still inside active()
+    unblock.set()       # now it unwinds (paired decrement)
+    t.join(timeout=5.0)
+    dog.start(0.2, on_hang=lambda s: None)
+    with dog.active():
+        assert dog.fired.wait(2.0), "re-armed watchdog is blind"
+
+
+def test_worker_config_arms_watchdog():
+    """DeviceHangTimeoutS > 0 on WorkerConfig starts the process
+    watchdog at worker boot, and the owning worker's shutdown stops it;
+    0 (the default) leaves it off."""
+    from tests.test_nodes import Stack
+
+    assert not WATCHDOG.running
+    stack = Stack(2, worker_extra={"DeviceHangTimeoutS": 300.0})
+    try:
+        assert WATCHDOG.running
+        assert WATCHDOG._timeout == 300.0
+        # one armed worker down, the other keeps its protection
+        stack.workers[0].shutdown()
+        assert WATCHDOG.running
+    finally:
+        stack.close()
+    assert not WATCHDOG.running, "last armed worker's shutdown must disarm"
+    # default config: off (reference parity)
+    stack = Stack(1)
+    try:
+        assert not WATCHDOG.running
+    finally:
+        stack.close()
