@@ -24,6 +24,10 @@ import json
 import sys
 import time
 
+# stdlib-only (the runtime layer has no jax dependency), so importing it
+# eagerly keeps the device-unreachable fast path light
+from distpow_tpu.runtime.watchdog import WATCHDOG
+
 
 def device_rate(step_builder, label: str, min_seconds: float = 2.0) -> float:
     """Sustained candidates/sec of a step(chunk0)->uint32 launcher.
@@ -40,21 +44,27 @@ def device_rate(step_builder, label: str, min_seconds: float = 2.0) -> float:
     """
     import jax.numpy as jnp
 
-    step, batch = step_builder()
-    int(step(jnp.uint32(1 << 24)))  # compile + real sync
+    # active window + beats: when main() arms the watchdog, a tunnel
+    # death mid-timing converts to the diagnostic JSON line instead of
+    # hanging the process forever (observed 2026-07-30 ~04:37, where a
+    # mid-bench outage wedged the whole measurement session)
+    with WATCHDOG.active():
+        step, batch = step_builder()
+        int(step(jnp.uint32(1 << 24)))  # compile + real sync
 
-    iters = 4
-    while True:
-        t0 = time.time()
-        out = None
-        for i in range(iters):
-            out = step(jnp.uint32(((1 << 24) + i * batch) & 0xFFFFFFFF))
-        sink = int(out)  # forces the whole FIFO of launches to complete
-        dt = time.time() - t0
-        if dt >= min_seconds or iters >= 1 << 10:
-            break
-        iters = min(1 << 10, max(iters * 2, int(iters * min_seconds / max(dt, 1e-3)) + 1))
-    del sink
+        iters = 4
+        while True:
+            WATCHDOG.beat()
+            t0 = time.time()
+            out = None
+            for i in range(iters):
+                out = step(jnp.uint32(((1 << 24) + i * batch) & 0xFFFFFFFF))
+            sink = int(out)  # forces the whole FIFO of launches to complete
+            dt = time.time() - t0
+            if dt >= min_seconds or iters >= 1 << 10:
+                break
+            iters = min(1 << 10, max(iters * 2, int(iters * min_seconds / max(dt, 1e-3)) + 1))
+        del sink
     rate = batch * iters / dt
     print(f"[bench] {label}: {rate / 1e6:.2f} MH/s "
           f"({iters} x {batch} candidates in {dt:.3f}s)", file=sys.stderr)
@@ -108,16 +118,18 @@ def measured_vpu_roofline(min_seconds: float = 2.0) -> float:
             acc = acc ^ y
         return acc[0]
 
-    int(run(jnp.uint32(1), 1))  # compile + sync
-    reps = 64
-    while True:
-        t0 = time.time()
-        sink = int(run(jnp.uint32(2), reps))
-        dt = time.time() - t0
-        if dt >= min_seconds or reps >= 1 << 20:
-            break
-        reps = max(reps * 2, int(reps * min_seconds / max(dt, 1e-3)) + 1)
-    del sink
+    with WATCHDOG.active():
+        int(run(jnp.uint32(1), 1))  # compile + sync
+        reps = 64
+        while True:
+            WATCHDOG.beat()
+            t0 = time.time()
+            sink = int(run(jnp.uint32(2), reps))
+            dt = time.time() - t0
+            if dt >= min_seconds or reps >= 1 << 20:
+                break
+            reps = max(reps * 2, int(reps * min_seconds / max(dt, 1e-3)) + 1)
+        del sink
     rate = n * reps * CHAINS * LINKS * OPS_PER_LINK / dt
     print(f"[bench] measured VPU int32 roofline: {rate / 1e12:.2f} Tops/s "
           f"({CHAINS} chains x {LINKS} rotl+add links x {reps} reps over "
@@ -174,6 +186,29 @@ def main() -> None:
             "vs_baseline": 0.0,
         }))
         return
+
+    # The boot probe only covers the START of the run: the tunnel has
+    # died MID-bench too (2026-07-30 ~04:37, BASELINE.md provenance),
+    # leaving the process hung in an uninterruptible dispatch with no
+    # JSON line ever emitted.  Arm the device-hang watchdog with an
+    # on_hang that emits the diagnostic line and exits cleanly, so the
+    # driver always records SOMETHING.  420s >> the longest legitimate
+    # beat gap (one cold kernel compile); beats come from device_rate,
+    # the roofline loop, warmup (_warm_factory), and the search driver.
+    def _hang_bailout(stale: float) -> None:
+        print(json.dumps({
+            "metric": "MH/s/chip md5 pow search (device hung mid-bench)",
+            "value": 0.0,
+            "unit": "MH/s",
+            "vs_baseline": 0.0,
+        }), flush=True)
+        print(f"[bench] device made no progress for {stale:.0f}s "
+              f"mid-run; presumed tunnel outage", file=sys.stderr)
+        import os
+
+        os._exit(0)
+
+    WATCHDOG.start(420.0, on_hang=_hang_bailout)
 
     from distpow_tpu.models.registry import get_hash_model
     from distpow_tpu.ops.search_step import build_search_step, cached_search_step
@@ -417,6 +452,9 @@ def main() -> None:
         print(f"[bench] hashlib CPU baseline: {baseline / 1e6:.2f} MH/s",
               file=sys.stderr)
 
+    # disarm BEFORE the real JSON line: the hang bailout must never
+    # print a second line after a successful run
+    WATCHDOG.stop()
     print(json.dumps({
         "metric": f"MH/s/chip md5 pow search ({best_label} path, diff=32bits)",
         "value": round(best / 1e6, 3),

@@ -28,6 +28,22 @@ def main() -> None:
 
     print(f"devices: {jax.devices()}", file=sys.stderr)
 
+    # a tunnel death mid-sweep must not wedge the session: device_rate
+    # beats the watchdog, so a stale active section means a hung
+    # dispatch — print what we have and die visibly (bench.py does the
+    # same with a JSON bailout line)
+    import os
+
+    from distpow_tpu.runtime.watchdog import WATCHDOG
+
+    def _bail(stale: float) -> None:
+        print(f"ABORT: device made no progress for {stale:.0f}s "
+              f"(presumed tunnel outage); partial results above stand",
+              file=sys.stderr)
+        os._exit(1)
+
+    WATCHDOG.start(420.0, on_hang=_bail)
+
     from distpow_tpu.ops.md5_pallas import build_pallas_search_step
     from distpow_tpu.ops.search_step import cached_search_step
     from distpow_tpu.parallel.search import launch_steps_for
