@@ -25,6 +25,9 @@ d = sys.argv[1]
 w = json.load(open(f"{d}/worker_config.json"))
 w["Backend"] = "jax"
 w["BatchSize"] = 1 << 21
+# tunnel deaths mid-run are a real occurrence (BASELINE.md provenance);
+# a hung dispatch should kill the worker visibly, not wedge the session
+w["DeviceHangTimeoutS"] = 420.0
 json.dump(w, open(f"{d}/worker_config.json", "w"))
 ts = json.load(open(f"{d}/tracing_server_config.json"))
 ts["OutputFile"] = f"{d}/trace_output.log"
