@@ -46,6 +46,7 @@ from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import WorkerConfig
 from ..runtime.rpc import RPCClient, RPCServer
 from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
+from ..runtime.watchdog import WATCHDOG
 
 log = logging.getLogger("distpow.worker")
 
@@ -251,6 +252,7 @@ class WorkerRPCHandler:
         snap["backend"] = type(self.backend).__name__
         snap["active_tasks"] = len(self._tasks)
         snap["cache_entries"] = len(self.result_cache)
+        snap["watchdog_armed"] = WATCHDOG.running
         return snap
 
     # -- miner (worker.go:258-401) -----------------------------------------
@@ -396,8 +398,6 @@ class Worker:
             # must not leak a ref the matching shutdown() will never
             # release (and nothing earlier runs inside an active()
             # section, so arming earlier would protect nothing).
-            from ..runtime.watchdog import WATCHDOG
-
             WATCHDOG.acquire(hang_timeout)
             self._armed_watchdog = True
         self._start_warmup(backend)
@@ -495,7 +495,5 @@ class Worker:
                 # vanish while other armed workers still serve
                 # (refcount).  In a finally: a close() failure above
                 # must not leak the ref.
-                from ..runtime.watchdog import WATCHDOG
-
                 WATCHDOG.release()
                 self._armed_watchdog = False

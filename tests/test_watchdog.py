@@ -141,6 +141,7 @@ def test_worker_config_arms_watchdog():
     try:
         assert WATCHDOG.running
         assert WATCHDOG._timeout == 300.0
+        assert stack.workers[0].handler.Stats({})["watchdog_armed"] is True
         # one armed worker down, the other keeps its protection
         stack.workers[0].shutdown()
         assert WATCHDOG.running
