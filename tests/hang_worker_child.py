@@ -1,0 +1,57 @@
+"""Worker child whose backend hangs forever — the zombie the watchdog
+exists to kill.
+
+Run by tests/test_watchdog.py::test_hung_worker_process_dies_and_request_completes:
+this process boots a real Worker (RPC server, forwarder, tracer) whose
+``search`` blocks inside a never-beating ``WATCHDOG.active()`` section —
+the process-level stand-in for a TPU dispatch that never returns
+(BASELINE.md round-3 provenance).  With ``DeviceHangTimeoutS`` set, the
+watchdog must end this process with ``os._exit(43)``; the parent test
+asserts the exit code and that the coordinator's
+``FailurePolicy="reassign"`` then completes the client's request via
+the healthy worker.
+
+Usage: python tests/hang_worker_child.py <listen_addr> <coord_addr>
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import distpow_tpu.nodes.worker as worker_mod  # noqa: E402
+from distpow_tpu.nodes.worker import Worker  # noqa: E402
+from distpow_tpu.runtime.config import WorkerConfig  # noqa: E402
+from distpow_tpu.runtime.watchdog import WATCHDOG  # noqa: E402
+
+
+class HangBackend:
+    """A dispatch that never returns and never beats."""
+
+    def __init__(self, **_):
+        pass
+
+    def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
+        with WATCHDOG.active():
+            threading.Event().wait()
+
+
+# swap the backend factory BEFORE Worker construction (the module-level
+# symbol nodes.worker resolved at import time)
+worker_mod.get_backend = lambda name, **kw: HangBackend()
+
+listen_addr, coord_addr = sys.argv[1], sys.argv[2]
+w = Worker(
+    WorkerConfig(
+        WorkerID="hangworker",
+        ListenAddr=listen_addr,
+        CoordAddr=coord_addr,
+        DeviceHangTimeoutS=2.0,
+        WarmupNonceLens=[],  # no warmup: the hang must come from Mine
+    )
+)
+w.initialize_rpcs()
+w.start_forwarder()
+print("HANG_WORKER_READY", flush=True)
+threading.Event().wait()  # serve until the watchdog kills the process

@@ -1,0 +1,139 @@
+"""Shared multi-process stack harness for process-surface tests.
+
+Boots the reference deployment shape (SURVEY.md §3.5) — tracing server,
+coordinator, workers, client — as real subprocesses on random localhost
+ports, with the config tweaks and teardown discipline every such test
+needs.  Used by tests/test_cli.py (demo scenario) and
+tests/test_watchdog.py (hung-worker recovery); keep fixes here so the
+copies cannot drift.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class ProcStack:
+    """Config generation + subprocess lifecycle for one test stack."""
+
+    def __init__(self, tmp_path, workers=2, seed=123,
+                 coord_overrides=None, worker_overrides=None):
+        from distpow_tpu.cli import config_gen
+
+        self.tmp = tmp_path
+        config_gen.main(["--config-dir", str(tmp_path),
+                         "--workers", str(workers), "--seed", str(seed)])
+        self.coord_cfg = self._edit("coordinator_config.json",
+                                    coord_overrides or {})
+        # python backend by default: subprocess workers should not pay
+        # JAX warmup unless a test opts in
+        self.worker_cfg = self._edit(
+            "worker_config.json", {"Backend": "python",
+                                   **(worker_overrides or {})})
+        self._edit("tracing_server_config.json", {
+            "OutputFile": str(tmp_path / "trace_output.log"),
+            "ShivizOutputFile": str(tmp_path / "shiviz_output.log"),
+        })
+        self.env = dict(os.environ)
+        self.env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU in subprocesses
+        self.env["JAX_PLATFORMS"] = "cpu"
+        self.procs = []
+
+    def _edit(self, name, overrides):
+        path = self.tmp / name
+        cfg = json.loads(path.read_text())
+        cfg.update(overrides)
+        path.write_text(json.dumps(cfg))
+        return cfg
+
+    def config(self, name):
+        return str(self.tmp / name)
+
+    def spawn(self, *argv, track=True):
+        p = subprocess.Popen(
+            [sys.executable, *argv], cwd=REPO, env=self.env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        if track:
+            self.procs.append(p)
+        return p
+
+    def boot_core(self):
+        """Tracing server then coordinator (order matters, SURVEY §3.5)."""
+        self.spawn("-m", "distpow_tpu.cli.tracing_server",
+                   "--config", self.config("tracing_server_config.json"))
+        time.sleep(0.5)
+        self.spawn("-m", "distpow_tpu.cli.coordinator",
+                   "--config", self.config("coordinator_config.json"))
+        time.sleep(0.5)
+
+    def boot_worker(self, index, wait_ready=True):
+        """CLI worker ``index`` (0-based) on its configured address.
+        ``wait_ready`` blocks on the worker's own "serving ... RPCs"
+        log line — a fixed sleep races the bind on loaded machines."""
+        p = self.spawn(
+            "-m", "distpow_tpu.cli.worker",
+            "--config", self.config("worker_config.json"),
+            "--id", f"worker{index + 1}",
+            "--listen", self.coord_cfg["Workers"][index],
+        )
+        if wait_ready:
+            self.wait_for_line(p, f"serving worker{index + 1} RPCs")
+        return p
+
+    def wait_for_line(self, proc, marker, timeout=30.0):
+        """Consume ``proc`` stdout until ``marker`` appears (readiness
+        handshake — fixed sleeps race on loaded machines).
+
+        The blocking readline runs in a helper thread so the deadline
+        preempts a silent-but-alive child; everything read so far rides
+        in the failure message (a silent flake is undiagnosable).  The
+        reader keeps draining after the match — a child that keeps
+        logging must not block on a full 64KB pipe — and stdout EOF
+        fails fast with the exit code instead of burning the timeout."""
+        import threading
+
+        lines = []
+        found_line = []
+        found = threading.Event()
+        eof = threading.Event()
+
+        def reader():
+            for line in proc.stdout:
+                lines.append(line)
+                if marker in line and not found.is_set():
+                    found_line.append(line)
+                    found.set()
+                # no early return: keep draining the pipe for the
+                # child's lifetime (daemon thread)
+            eof.set()
+
+        threading.Thread(target=reader, daemon=True).start()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if found.wait(0.05):
+                return found_line[0]
+            if eof.is_set():
+                raise AssertionError(
+                    f"child exited (rc={proc.poll()}) before {marker!r} "
+                    f"appeared; output:\n{''.join(lines)[-2000:]}"
+                )
+        raise AssertionError(
+            f"{marker!r} never appeared on stdout within {timeout}s; "
+            f"output so far:\n{''.join(lines)[-2000:]}"
+        )
+
+    def close(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in self.procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -3,9 +3,7 @@ reference demo scenario as real OS processes on localhost — the closest
 analogue of actually deploying the reference's five binaries
 (SURVEY.md section 3.5 startup sequence)."""
 
-import json
 import os
-import signal
 import subprocess
 import sys
 import time
@@ -75,48 +73,22 @@ def test_multiprocess_demo_scenario(tmp_path):
     """Boot tracing server + coordinator + 2 workers + demo client as
     subprocesses, difficulty 2/4 nibbles, python backend (no JAX warmup
     in the workers keeps this fast)."""
-    config_gen.main(["--config-dir", str(tmp_path), "--workers", "2", "--seed", "123"])
-    # worker backend: python for subprocess speed
-    wcfg = json.loads((tmp_path / "worker_config.json").read_text())
-    wcfg["Backend"] = "python"
-    (tmp_path / "worker_config.json").write_text(json.dumps(wcfg))
-    coord = read_json_config(tmp_path / "coordinator_config.json", CoordinatorConfig)
-    ts_cfg = json.loads((tmp_path / "tracing_server_config.json").read_text())
-    ts_cfg["OutputFile"] = str(tmp_path / "trace_output.log")
-    ts_cfg["ShivizOutputFile"] = str(tmp_path / "shiviz_output.log")
-    (tmp_path / "tracing_server_config.json").write_text(json.dumps(ts_cfg))
+    from tests.proc_harness import ProcStack
 
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU in subprocesses
-    env["JAX_PLATFORMS"] = "cpu"
-
-    def spawn(*args):
-        return subprocess.Popen(
-            [sys.executable, "-m", *args],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-
-    procs = []
+    stack = ProcStack(tmp_path, workers=2, seed=123)
     try:
-        procs.append(spawn("distpow_tpu.cli.tracing_server",
-                           "--config", str(tmp_path / "tracing_server_config.json")))
-        time.sleep(0.5)
-        procs.append(spawn("distpow_tpu.cli.coordinator",
-                           "--config", str(tmp_path / "coordinator_config.json")))
-        time.sleep(0.5)
-        for i, addr in enumerate(coord.Workers):
-            procs.append(spawn("distpow_tpu.cli.worker",
-                               "--config", str(tmp_path / "worker_config.json"),
-                               "--id", f"worker{i + 1}", "--listen", addr))
+        stack.boot_core()
+        for i in range(len(stack.coord_cfg["Workers"])):
+            stack.boot_worker(i)
         time.sleep(0.5)
 
-        client = spawn("distpow_tpu.cli.client",
-                       "--config", str(tmp_path / "client_config.json"),
-                       "--config2", str(tmp_path / "client2_config.json"),
-                       # bits unit: 8 bits = 2 nibbles (exercises the
-                       # SURVEY §7 difficulty-unit translation end-to-end)
-                       "--difficulty-bits", "8")
+        client = stack.spawn(
+            "-m", "distpow_tpu.cli.client",
+            "--config", stack.config("client_config.json"),
+            "--config2", stack.config("client2_config.json"),
+            # bits unit: 8 bits = 2 nibbles (exercises the
+            # SURVEY §7 difficulty-unit translation end-to-end)
+            "--difficulty-bits", "8")
         out, _ = client.communicate(timeout=120)
         assert client.returncode == 0, out
         assert out.count("MineResult") == 4, out
@@ -132,13 +104,7 @@ def test_multiprocess_demo_scenario(tmp_path):
         assert shiviz.startswith("(?<host>")
         assert "coordinator {" in shiviz
     finally:
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        stack.close()
 
 
 def test_worker_multihost_bootstrap_subprocess():

@@ -13,7 +13,7 @@ import time
 
 import pytest
 
-from distpow_tpu.runtime.watchdog import WATCHDOG, DeviceWatchdog
+from distpow_tpu.runtime.watchdog import EXIT_CODE, WATCHDOG, DeviceWatchdog
 
 
 @pytest.fixture
@@ -152,5 +152,46 @@ def test_worker_config_arms_watchdog():
     stack = Stack(1)
     try:
         assert not WATCHDOG.running
+    finally:
+        stack.close()
+
+
+@pytest.mark.slow
+def test_hung_worker_process_dies_and_request_completes(tmp_path):
+    """The full recovery chain at the process level: a worker whose
+    backend wedges (tests/hang_worker_child.py — the stand-in for a TPU
+    dispatch that never returns) still answers Ping, so ONLY the
+    watchdog can unblock the protocol: it kills the worker with
+    EXIT_CODE, the coordinator's FailurePolicy="reassign" prunes it,
+    and the healthy worker completes every client request."""
+    from tests.proc_harness import ProcStack
+
+    stack = ProcStack(
+        tmp_path, workers=2, seed=777,
+        coord_overrides={"FailurePolicy": "reassign",
+                         "FailureProbeSecs": 0.5},
+    )
+    try:
+        stack.boot_core()
+        hang_child = stack.spawn(
+            "tests/hang_worker_child.py", stack.coord_cfg["Workers"][0],
+            stack.coord_cfg["WorkerAPIListenAddr"],
+        )
+        stack.boot_worker(1)  # blocks on its "serving ... RPCs" line
+        stack.wait_for_line(hang_child, "HANG_WORKER_READY")
+
+        client = stack.spawn(
+            "-m", "distpow_tpu.cli.client",
+            "--config", stack.config("client_config.json"),
+            "--config2", stack.config("client2_config.json"),
+            "--difficulty", "2",
+        )
+        out, _ = client.communicate(timeout=120)
+        assert client.returncode == 0, out
+        assert out.count("MineResult") == 4, out
+
+        # the zombie died by watchdog (exit 43), not by our teardown
+        rc = hang_child.wait(timeout=30)
+        assert rc == EXIT_CODE, (rc, hang_child.stdout.read())
     finally:
         stack.close()
