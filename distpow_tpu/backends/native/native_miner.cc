@@ -141,6 +141,42 @@ void CompressSha256(uint32_t state[8], const uint8_t block[64]) {
   state[4] += e; state[5] += f; state[6] += g; state[7] += h;
 }
 
+constexpr uint32_t kSha1Init[5] = {0x67452301u, 0xefcdab89u, 0x98badcfeu,
+                                   0x10325476u, 0xc3d2e1f0u};
+// one constant per 20-round group (FIPS 180-4 section 4.2.1)
+constexpr uint32_t kSha1K[4] = {0x5a827999u, 0x6ed9eba1u, 0x8f1bbcdcu,
+                                0xca62c1d6u};
+
+void CompressSha1(uint32_t state[5], const uint8_t block[64]) {
+  uint32_t w[80];
+  for (int i = 0; i < 16; ++i) {
+    w[i] = (static_cast<uint32_t>(block[4 * i]) << 24) |
+           (static_cast<uint32_t>(block[4 * i + 1]) << 16) |
+           (static_cast<uint32_t>(block[4 * i + 2]) << 8) |
+           static_cast<uint32_t>(block[4 * i + 3]);
+  }
+  for (int i = 16; i < 80; ++i) {
+    w[i] = Rotl(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1);
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3],
+           e = state[4];
+  for (int i = 0; i < 80; ++i) {
+    uint32_t f;
+    if (i < 20) {
+      f = (b & c) | (~b & d);
+    } else if (i < 40) {
+      f = b ^ c ^ d;
+    } else if (i < 60) {
+      f = (b & c) | (b & d) | (c & d);
+    } else {
+      f = b ^ c ^ d;
+    }
+    const uint32_t temp = Rotl(a, 5) + f + e + kSha1K[i / 20] + w[i];
+    e = d; d = c; c = Rotl(b, 30); b = a; a = temp;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d; state[4] += e;
+}
+
 // --- hash traits bound into the templated scan loop ------------------------
 
 struct Md5Traits {
@@ -166,6 +202,24 @@ struct Sha256Traits {
   }
   static void StoreDigest(const uint32_t* state, uint8_t* out) {
     for (int i = 0; i < 8; ++i) {  // big-endian word serialization
+      out[4 * i] = static_cast<uint8_t>(state[i] >> 24);
+      out[4 * i + 1] = static_cast<uint8_t>(state[i] >> 16);
+      out[4 * i + 2] = static_cast<uint8_t>(state[i] >> 8);
+      out[4 * i + 3] = static_cast<uint8_t>(state[i]);
+    }
+  }
+};
+
+struct Sha1Traits {
+  static constexpr int kStateWords = 5;
+  static constexpr int kDigestBytes = 20;
+  static constexpr bool kBigEndianLength = true;
+  static const uint32_t* Init() { return kSha1Init; }
+  static void Compress(uint32_t* state, const uint8_t* block) {
+    CompressSha1(state, block);
+  }
+  static void StoreDigest(const uint32_t* state, uint8_t* out) {
+    for (int i = 0; i < 5; ++i) {  // big-endian word serialization
       out[4 * i] = static_cast<uint8_t>(state[i] >> 24);
       out[4 * i + 1] = static_cast<uint8_t>(state[i] >> 16);
       out[4 * i + 2] = static_cast<uint8_t>(state[i] >> 8);
@@ -345,7 +399,7 @@ extern "C" {
 // acceptable per the puzzle contract, coordinator.go:202).
 //
 // `algo`: 0 = MD5 (reference parity), 1 = SHA-256 (the north-star hash
-// option); -2 on any other value.
+// option), 2 = SHA-1; -2 on any other value.
 int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint32_t difficulty, uint32_t algo,
                          const uint8_t* thread_bytes,
@@ -353,12 +407,14 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint64_t chunk_count, int32_t n_threads,
                          const volatile int32_t* cancel_flag,
                          uint64_t* out_hashes, uint8_t* out_secret) {
-  if (n_tb == 0 || width > 8 || algo > 1) return -2;
+  if (n_tb == 0 || width > 8 || algo > 2) return -2;
   // a difficulty beyond the digest's nibble count would read past the
   // digest buffer in MeetsDifficulty (and the puzzle is unsatisfiable
   // anyway — the JAX paths reject it in nibble_masks)
   const uint32_t max_nibbles =
-      2 * (algo == 0 ? Md5Traits::kDigestBytes : Sha256Traits::kDigestBytes);
+      2 * (algo == 0   ? Md5Traits::kDigestBytes
+           : algo == 1 ? Sha256Traits::kDigestBytes
+                       : Sha1Traits::kDigestBytes);
   if (difficulty > max_nibbles) return -2;
   SearchTask task{nonce,        nonce_len,  difficulty,
                   thread_bytes, n_tb,       width,
@@ -368,8 +424,10 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
 
   if (algo == 0) {
     SearchRange<Md5Traits>(task, chunk_count, n_threads, &found, &hashes);
-  } else {
+  } else if (algo == 1) {
     SearchRange<Sha256Traits>(task, chunk_count, n_threads, &found, &hashes);
+  } else {
+    SearchRange<Sha1Traits>(task, chunk_count, n_threads, &found, &hashes);
   }
 
   if (out_hashes) *out_hashes = hashes;
@@ -393,6 +451,10 @@ void distpow_md5(const uint8_t* data, size_t len, uint8_t out[16]) {
 
 void distpow_sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
   DigestBuffer<Sha256Traits>(data, len, out);
+}
+
+void distpow_sha1(const uint8_t* data, size_t len, uint8_t out[20]) {
+  DigestBuffer<Sha1Traits>(data, len, out);
 }
 
 }  // extern "C"

@@ -57,7 +57,7 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_search_range.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,          # nonce
             ctypes.c_uint32,                            # difficulty
-            ctypes.c_uint32,                            # algo (0 md5, 1 sha256)
+            ctypes.c_uint32,                    # algo (0 md5, 1 sha256, 2 sha1)
             ctypes.c_char_p, ctypes.c_size_t,          # thread bytes
             ctypes.c_uint32,                            # width
             ctypes.c_uint64, ctypes.c_uint64,          # chunk start/count
@@ -74,11 +74,15 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_sha256.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.distpow_sha1.restype = None
+        lib.distpow_sha1.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
         _lib = lib
         return lib
 
 
-ALGO_IDS = {"md5": 0, "sha256": 1}
+ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2}
 
 
 def native_md5(data: bytes) -> bytes:
@@ -92,6 +96,13 @@ def native_sha256(data: bytes) -> bytes:
     lib = load_library()
     out = ctypes.create_string_buffer(32)
     lib.distpow_sha256(data, len(data), out)
+    return out.raw
+
+
+def native_sha1(data: bytes) -> bytes:
+    lib = load_library()
+    out = ctypes.create_string_buffer(20)
+    lib.distpow_sha1(data, len(data), out)
     return out.raw
 
 
@@ -126,7 +137,9 @@ class NativeBackend:
         cancel_check: Optional[Callable[[], bool]] = None,
     ) -> Optional[bytes]:
         nonce = bytes(nonce)
-        max_nibbles = {"md5": 32, "sha256": 64}[self.hash_model]
+        from ..models.registry import get_hash_model
+
+        max_nibbles = get_hash_model(self.hash_model).max_difficulty
         if difficulty > max_nibbles:
             # unsatisfiable: same contract as the JAX driver
             # (parallel/search.py) — the reference would brute-force

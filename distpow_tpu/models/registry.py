@@ -13,7 +13,7 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Sequence, Tuple
 
-from . import md5_jax, sha256_jax
+from . import md5_jax, sha1_jax, sha256_jax
 
 
 @dataclass(frozen=True)
@@ -68,7 +68,19 @@ SHA256 = HashModel(
     py_absorb=sha256_jax.py_absorb,
 )
 
-_REGISTRY: Dict[str, HashModel] = {"md5": MD5, "sha256": SHA256}
+SHA1 = HashModel(
+    name="sha1",
+    block_bytes=sha1_jax.BLOCK_BYTES,
+    digest_words=sha1_jax.DIGEST_WORDS,
+    word_byteorder=sha1_jax.WORD_BYTEORDER,
+    length_byteorder=sha1_jax.LENGTH_BYTEORDER,
+    init_state=sha1_jax.SHA1_INIT,
+    compress=sha1_jax.sha1_compress,
+    py_compress=sha1_jax.py_compress,
+    py_absorb=sha1_jax.py_absorb,
+)
+
+_REGISTRY: Dict[str, HashModel] = {"md5": MD5, "sha256": SHA256, "sha1": SHA1}
 
 
 def get_hash_model(name: str) -> HashModel:

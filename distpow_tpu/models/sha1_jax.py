@@ -1,0 +1,198 @@
+"""SHA-1 as a vectorized JAX computation over uint32 lanes.
+
+Third hash model in the pluggable registry (``models/registry.py``; the
+reference hard-codes MD5 at worker.go:5,353 and BASELINE.json's north
+star names SHA-256) — included to pin the model abstraction: everything
+below the registry (packing, difficulty masks, search step, backends,
+the native miner) is hash-agnostic, so a new model is exactly one
+compression function plus a registry entry.
+
+Same interface as ``md5_jax``/``sha256_jax`` (16 broadcastable message
+words in, state out) and the same platform-keyed compilation strategy
+as SHA-256: the 80-round graph is fully unrolled on accelerators (the
+message schedule stays a plain Python list, so entries fed only by
+constant words remain scalars) and a ``lax.fori_loop`` with a rolling
+16-word window on XLA:CPU, whose codegen blows up on big unrolled hash
+graphs (see sha256_jax.py module docstring).  Correctness pinned
+against ``hashlib`` in tests/test_hash_models.py.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+SHA1_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
+
+# One constant per 20-round group (FIPS 180-4 §4.2.1).
+SHA1_K = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
+
+BLOCK_BYTES = 64
+DIGEST_WORDS = 5
+WORD_BYTEORDER = "big"
+LENGTH_BYTEORDER = "big"
+
+
+def _u32(x):
+    return x if hasattr(x, "dtype") else jnp.uint32(np.uint32(x))
+
+
+def _rotl(x, s):
+    return (x << s) | (x >> (32 - s))
+
+
+def _round(st, i, w_i):
+    a, b, c, d, e = st
+    if i < 20:
+        f = (b & c) | (~b & d)
+    elif i < 40:
+        f = b ^ c ^ d
+    elif i < 60:
+        f = (b & c) | (b & d) | (c & d)
+    else:
+        f = b ^ c ^ d
+    # (k + w) grouped: a scalar-unit add for constant/scalar message
+    # words (XLA does not reassociate integer adds; same rationale as
+    # sha256_jax._round)
+    temp = _rotl(a, 5) + f + e + (jnp.uint32(SHA1_K[i // 20]) + w_i)
+    return (temp, a, _rotl(b, 30), c, d)
+
+
+def _compress_unrolled(state, words):
+    """Fully unrolled 80-round form (accelerators): schedule entries fed
+    only by constant words stay scalars through the recursion."""
+    w = [_u32(m) for m in words]
+    for i in range(16, 80):
+        w.append(_rotl(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1))
+    st = tuple(_u32(s) for s in state)
+    for i in range(80):
+        st = _round(st, i, w[i])
+    return tuple(_u32(s0) + s for s0, s in zip(state, st))
+
+
+def _compress_loop(state, words):
+    """fori_loop form (XLA:CPU): rounds 0-15 unrolled on the raw words,
+    rounds 16-79 carry a rolling 16-word window.  The round function
+    switches at fixed indices, so the loop runs as four 20-round spans
+    (16-20 is finished inside the first span's unrolled prefix).
+
+    The window is one stacked (16, *batch) array, not a tuple: under
+    ``shard_map`` some message words vary across the mesh axis and some
+    are replicated, and rotating a tuple would move a varying value
+    into a replicated slot — a carry-type mismatch the stack avoids by
+    unifying the axis-varying type at construction."""
+    ws = [_u32(m) for m in words]
+    shape = jnp.broadcast_shapes(*(jnp.shape(w) for w in ws))
+    st = tuple(_u32(s) for s in state)
+    for i in range(16):
+        st = _round(st, i, ws[i])
+
+    window = jnp.stack([jnp.broadcast_to(w, shape) for w in ws])
+    # varying-typed zero: rows of the stacked window share the JOINT
+    # axis-varying type, so adding it unifies each state word's type
+    # too (a state word fed only by replicated message words would
+    # otherwise flip to varying mid-loop as the rotation mixes them)
+    vzero = window[0] & jnp.uint32(0)
+    st = tuple(jnp.broadcast_to(s, shape) + vzero for s in st)
+
+    def make_body(group):
+        k = jnp.uint32(SHA1_K[group])
+
+        def body(i, carry):
+            st, win = carry
+            w_new = _rotl(win[13] ^ win[8] ^ win[2] ^ win[0], 1)
+            a, b, c, d, e = st
+            if group == 0:
+                f = (b & c) | (~b & d)
+            elif group == 2:
+                f = (b & c) | (b & d) | (c & d)
+            else:
+                f = b ^ c ^ d
+            temp = _rotl(a, 5) + f + e + (k + w_new)
+            return ((temp, a, _rotl(b, 30), c, d),
+                    jnp.concatenate([win[1:], w_new[None]], axis=0))
+
+        return body
+
+    carry = (st, window)
+    for group, (lo, hi) in enumerate(((16, 20), (20, 40), (40, 60), (60, 80))):
+        carry = lax.fori_loop(lo, hi, make_body(group), carry, unroll=4)
+    st, _ = carry
+    return tuple(_u32(s0) + s for s0, s in zip(state, st))
+
+
+@jax.jit
+def _sha1_compress_jit(state, words):
+    # platform-keyed like sha256: loop on XLA:CPU, unrolled elsewhere
+    if jax.default_backend() == "cpu":
+        return _compress_loop(state, words)
+    return _compress_unrolled(state, words)
+
+
+def sha1_compress(state, words: Sequence):
+    """One SHA-1 block compression, vectorized over broadcastable words."""
+    return _sha1_compress_jit(
+        tuple(_u32(s) for s in state), tuple(_u32(w) for w in words)
+    )
+
+
+def sha1_digest_words(blocks: Sequence[Sequence]) -> Tuple:
+    state = SHA1_INIT
+    for words in blocks:
+        state = sha1_compress(state, words)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python twin (host-side prefix absorption + oracle).
+# ---------------------------------------------------------------------------
+
+_MASK = 0xFFFFFFFF
+
+
+def _py_rotl(x: int, s: int) -> int:
+    return ((x << s) | (x >> (32 - s))) & _MASK
+
+
+def py_compress(state: Tuple[int, ...], block: bytes) -> Tuple[int, ...]:
+    assert len(block) == BLOCK_BYTES
+    w = list(struct.unpack(">16I", block))
+    for i in range(16, 80):
+        w.append(_py_rotl(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1))
+    a, b, c, d, e = state
+    for i in range(80):
+        if i < 20:
+            f = (b & c) | (~b & d & _MASK)
+        elif i < 40:
+            f = b ^ c ^ d
+        elif i < 60:
+            f = (b & c) | (b & d) | (c & d)
+        else:
+            f = b ^ c ^ d
+        temp = (_py_rotl(a, 5) + f + e + SHA1_K[i // 20] + w[i]) & _MASK
+        a, b, c, d, e = temp, a, _py_rotl(b, 30), c, d
+    out = (a, b, c, d, e)
+    return tuple((s0 + s) & _MASK for s0, s in zip(state, out))
+
+
+def py_absorb(prefix: bytes):
+    state = SHA1_INIT
+    n_full = len(prefix) // BLOCK_BYTES
+    for i in range(n_full):
+        state = py_compress(state, prefix[i * BLOCK_BYTES : (i + 1) * BLOCK_BYTES])
+    return state, prefix[n_full * BLOCK_BYTES :], n_full * BLOCK_BYTES
+
+
+def py_digest(message: bytes) -> bytes:
+    state, rem, _ = py_absorb(message)
+    tail = rem + b"\x80"
+    tail += b"\x00" * ((-len(tail) - 8) % BLOCK_BYTES)
+    tail += struct.pack(">Q", len(message) * 8)
+    for i in range(0, len(tail), BLOCK_BYTES):
+        state = py_compress(state, tail[i : i + BLOCK_BYTES])
+    return b"".join(w.to_bytes(4, "big") for w in state)

@@ -9,8 +9,9 @@ load unchanged.  TPU-specific extensions are additive with defaults:
 * ``WorkerConfig.Backend``   — miner backend: ``jax`` (single device,
   default), ``jax-mesh`` (shard_map over all local devices), ``python``
   (hashlib loop, the CPU-parity baseline), ``native`` (C++ miner).
-* ``WorkerConfig.HashModel`` — ``md5`` (reference parity, default) or
-  ``sha256`` (north-star variant).
+* ``WorkerConfig.HashModel`` — any registry model
+  (models/registry.py): ``md5`` (reference parity, default),
+  ``sha256`` (north-star variant), or ``sha1``.
 * ``WorkerConfig.BatchSize`` — candidates per device launch.
 
 Unknown JSON fields are ignored (forward compatibility); missing fields

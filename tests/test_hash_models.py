@@ -8,8 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distpow_tpu.models import md5_jax, sha256_jax
-from distpow_tpu.models.registry import MD5, SHA256, get_hash_model
+from distpow_tpu.models import md5_jax, sha1_jax, sha256_jax
+from distpow_tpu.models.registry import MD5, SHA1, SHA256, get_hash_model
 
 
 def pad_md5(message: bytes) -> bytes:
@@ -54,6 +54,17 @@ def test_sha256_jax_vs_hashlib(length):
     assert digest == hashlib.sha256(msg).digest()
 
 
+@pytest.mark.parametrize("length", [0, 1, 8, 55, 56, 64, 65, 130])
+def test_sha1_jax_vs_hashlib(length):
+    rng = random.Random(2000 + length)
+    msg = bytes(rng.randrange(256) for _ in range(length))
+    # same big-endian single-padding scheme as sha256 (FIPS 180-4)
+    words = blocks_to_words(pad_sha256(msg), "big")
+    state = sha1_jax.sha1_digest_words(words)
+    digest = b"".join(int(w).to_bytes(4, "big") for w in state)
+    assert digest == hashlib.sha1(msg).digest()
+
+
 def test_md5_jax_vectorized_batch():
     # the compression must vectorize over batch-shaped message words
     rng = random.Random(7)
@@ -69,15 +80,15 @@ def test_md5_jax_vectorized_batch():
         assert digest == hashlib.md5(m).digest()
 
 
-@pytest.mark.parametrize("model,href", [(MD5, hashlib.md5), (SHA256, hashlib.sha256)])
+@pytest.mark.parametrize("model,href", [(MD5, hashlib.md5),
+                                        (SHA256, hashlib.sha256),
+                                        (SHA1, hashlib.sha1)])
 @pytest.mark.parametrize("length", [0, 5, 63, 64, 70, 128, 129])
 def test_py_twins_vs_hashlib(model, href, length):
     rng = random.Random(length * 31)
     msg = bytes(rng.randrange(256) for _ in range(length))
-    if model is MD5:
-        assert md5_jax.py_digest(msg) == href(msg).digest()
-    else:
-        assert sha256_jax.py_digest(msg) == href(msg).digest()
+    mod = {MD5: md5_jax, SHA256: sha256_jax, SHA1: sha1_jax}[model]
+    assert mod.py_digest(msg) == href(msg).digest()
 
 
 def test_py_absorb_prefix_state():
@@ -101,7 +112,9 @@ def test_py_absorb_prefix_state():
 def test_registry():
     assert get_hash_model("md5") is MD5
     assert get_hash_model("SHA256") is SHA256
+    assert get_hash_model("sha1") is SHA1
     assert MD5.max_difficulty == 32
     assert SHA256.max_difficulty == 64
+    assert SHA1.max_difficulty == 40
     with pytest.raises(ValueError):
         get_hash_model("sha1024")

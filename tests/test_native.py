@@ -59,9 +59,30 @@ def test_native_backend_sha256_long_nonce_multiblock():
                                           algo="sha256")
 
 
+@pytest.mark.parametrize("length", [0, 1, 55, 56, 64, 130])
+def test_native_sha1_vs_hashlib(length):
+    import random
+
+    rng = random.Random(2000 + length)
+    data = bytes(rng.randrange(256) for _ in range(length))
+    assert native.native_sha1(data) == hashlib.sha1(data).digest()
+
+
+def test_native_backend_sha1_matches_oracle():
+    """Sha1Traits through the same templated scan loop: reference
+    enumeration order for the third registry model too."""
+    backend = native.NativeBackend(hash_model="sha1", n_threads=1)
+    for nonce in (b"\x01\x02\x03\x04", b"\xcc\xdd"):
+        for difficulty in (1, 2, 3):
+            tbs = list(range(256))
+            secret = backend.search(nonce, difficulty, tbs)
+            assert secret == puzzle.python_search(
+                nonce, difficulty, tbs, algo="sha1")
+
+
 def test_native_backend_rejects_unknown_model():
     with pytest.raises(ValueError, match="native backend implements"):
-        native.NativeBackend(hash_model="sha1")
+        native.NativeBackend(hash_model="blake3")
 
 
 def test_native_backend_unsatisfiable_difficulty_blocks_until_cancel():

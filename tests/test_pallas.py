@@ -87,6 +87,17 @@ def test_pallas_backend_end_to_end():
     assert secret == puzzle.python_search(nonce, 2, tbs)
 
 
+def test_pallas_backend_falls_back_for_model_without_kernel():
+    # sha1 has no _TILE_FNS entry -> transparent XLA fallback, same
+    # enumeration order as the oracle
+    backend = PallasBackend(hash_model="sha1", batch_size=1 << 14,
+                            interpret=True)
+    nonce = b"\x11\x22"
+    secret = backend.search(nonce, 2, list(range(256)))
+    assert secret == puzzle.python_search(nonce, 2, list(range(256)),
+                                          algo="sha1")
+
+
 def test_pallas_backend_falls_back_for_long_nonce():
     # two-block tail -> transparent XLA fallback inside the factory
     backend = PallasBackend(batch_size=1 << 14, sublanes=8, interpret=True)
