@@ -1,4 +1,5 @@
-"""Pallas TPU kernels for the fused proof-of-work search step (MD5 + SHA-256).
+"""Pallas TPU kernels for the fused proof-of-work search step
+(MD5, SHA-256, SHA-1 — every ``_TILE_FNS`` model).
 
 The hot op of the framework (SURVEY.md section 7 layer 4, the "north
 star"): one kernel launch evaluates a dense tile grid of candidates —
@@ -59,6 +60,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..models.md5_jax import MD5_K, MD5_S
 from ..models.registry import get_hash_model
+from ..models.sha1_jax import SHA1_K
 from ..models.sha256_jax import SHA256_K
 from .difficulty import nibble_masks
 from .packing import build_tail_spec
@@ -76,7 +78,11 @@ LANES = 128
 # (16, *) at 1954 MH/s vs (8, *) at 1298 — two vregs per live value
 # beats one; at sublanes=8 the per-tile fixed cost (iota, hit
 # accumulation) is amortized over half as many candidates and dominates.
-MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (16, 1024)}
+# sha1's (16, 1024) is by analogy with the swept sha256 point (similar
+# live-set shape: a 16-word schedule window + a short working chain),
+# NOT hardware-swept yet — sweep before trusting it for serving.
+MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (16, 1024),
+                  "sha1": (16, 1024)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 
 
@@ -100,6 +106,21 @@ def default_geometry(model_name: str, interpret: bool = False):
 
 def _rotl(x, s: int):
     return (x << s) | (x >> (32 - s))
+
+
+def _round_key(k: int, m):
+    """``K[i] + w[i]`` as one grouped addend, shared by every tile.
+
+    For a CONSTANT message word (python int or 0-d scalar) the round
+    constant folds into it on the scalar unit — one scalar-vector add
+    in the consuming expression instead of two (XLA's static regime
+    gets this from compile-time constant folding; Mosaic cannot, so
+    the fold happens here).  For a batch word the grouping is
+    op-count-neutral (uint32 wraparound adds are associative), so the
+    call sites need no branch."""
+    if hasattr(m, "ndim") and m.ndim == 0 or not hasattr(m, "dtype"):
+        return jnp.uint32(k) + jnp.uint32(m)
+    return jnp.uint32(k) + m
 
 
 def _md5_tile(words, init, mask_words: int = 4):
@@ -138,15 +159,7 @@ def _md5_tile(words, init, mask_words: int = 4):
         else:
             f = c ^ (b | ~d)
             g = (7 * i) % 16
-        m = words[g]
-        if hasattr(m, "ndim") and m.ndim == 0 or not hasattr(m, "dtype"):
-            # constant message word: fold the round constant into it on
-            # the scalar unit — one scalar-vector add instead of two.
-            # XLA's static regime gets this from compile-time constant
-            # folding; here the fold is a cheap scalar op per round.
-            f = f + a + (jnp.uint32(MD5_K[i]) + jnp.uint32(m))
-        else:
-            f = f + a + jnp.uint32(MD5_K[i]) + m
+        f = f + a + _round_key(MD5_K[i], words[g])
         a, d, c = d, c, b
         b = b + _rotl(f, MD5_S[i])
     # un-shuffle the skipped rounds: after round r the registers hold the
@@ -207,13 +220,7 @@ def _sha256_tile(words, init, mask_words: int = 8):
         e1, f1, g1, h1 = E[r - 1], E[r - 2], E[r - 3], E[r - 4]
         S1 = _rotr(e1, 6) ^ _rotr(e1, 11) ^ _rotr(e1, 25)
         ch = (e1 & f1) ^ (~e1 & g1)
-        m = w[r]
-        if hasattr(m, "ndim") and m.ndim == 0 or not hasattr(m, "dtype"):
-            # constant message word: fold the round constant on the
-            # scalar unit (same trick as _md5_tile)
-            t1 = h1 + S1 + ch + (jnp.uint32(SHA256_K[r]) + jnp.uint32(m))
-        else:
-            t1 = h1 + S1 + ch + jnp.uint32(SHA256_K[r]) + m
+        t1 = h1 + S1 + ch + _round_key(SHA256_K[r], w[r])
         E[r] = A[r - 4] + t1
         if r <= maxA:
             a1, b1, c1 = A[r - 1], A[r - 2], A[r - 3]
@@ -230,10 +237,77 @@ def _sha256_tile(words, init, mask_words: int = 8):
     return tuple(out)
 
 
+def _sha1_tile(words, init, mask_words: int = 5):
+    """DCE'd SHA-1 compression on a tile; ``words[g]`` array or scalar.
+
+    Functional single-chain form: with ``X[r]`` the new ``a`` after
+    round ``r``, the other four working registers are just delayed,
+    rotated copies of the chain — the round inputs are
+
+        a = X[r-1],  b = X[r-2],  c = rotl(X[r-3], 30),
+        d = rotl(X[r-4], 30),  e = rotl(X[r-5], 30)
+
+    (with the raw init words standing in at the seam, rounds 0-4), so
+    one round computes only
+
+        X[r] = rotl(a, 5) + f(b, c, d) + e + (K[r//20] + w[r])
+
+    and digest word j is ``init[j] + X[79-j]`` for j < 2 or
+    ``init[j] + rotl(X[79-j], 30)`` for j >= 2.  ``mask_words``
+    trailing digest words are live (ops/search_step.py mask_words_for),
+    so the chain stops at round ``74 + mask_words`` — the dominant
+    difficulty <= 8-nibble bucket (mw=1) skips 4 rounds and schedule
+    words 76-79.  Returns 5 entries, ``None`` where dead.
+    """
+    mw = max(1, min(5, mask_words))
+    last = 74 + mw  # highest X index needed
+
+    w = list(words)
+    for i in range(16, last + 1):
+        w.append(_rotl(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1))
+
+    a0, b0, c0, d0, e0 = init
+    # seam: rounds 0-4 draw some inputs from the raw init words, which
+    # are NOT rotl-related to the chain.  Unrolling the first rounds by
+    # hand shows c/d/e all follow the same rule: raw X[idx] for
+    # idx <= -3 (c0/d0/e0 are already in final orientation), rotl for
+    # idx >= -2 (a0/b0 enter the c/d/e positions via the b->c rotation).
+    X = {-1: a0, -2: b0, -3: c0, -4: d0, -5: e0}
+
+    def rot_in(idx):
+        return X[idx] if idx <= -3 else _rotl(X[idx], 30)
+
+    for r in range(last + 1):
+        a = X[r - 1]
+        b = X[r - 2]
+        c = rot_in(r - 3)
+        d = rot_in(r - 4)
+        e = rot_in(r - 5)
+        if r < 20:
+            f = (b & c) | (~b & d)
+        elif r < 40:
+            f = b ^ c ^ d
+        elif r < 60:
+            f = (b & c) | (b & d) | (c & d)
+        else:
+            f = b ^ c ^ d
+        X[r] = _rotl(a, 5) + f + e + _round_key(SHA1_K[r // 20], w[r])
+
+    out = []
+    for j in range(5):
+        if j < 5 - mw:
+            out.append(None)
+        else:
+            x = X[79 - j]
+            out.append(init[j] + (x if j < 2 else _rotl(x, 30)))
+    return tuple(out)
+
+
 # model -> (tile fn, init-state words, digest words); a model has a
 # kernel iff it has an entry here, and MODEL_GEOMETRY above is checked
 # against this at import so the two can't drift apart.
-_TILE_FNS = {"md5": (_md5_tile, 4, 4), "sha256": (_sha256_tile, 8, 8)}
+_TILE_FNS = {"md5": (_md5_tile, 4, 4), "sha256": (_sha256_tile, 8, 8),
+             "sha1": (_sha1_tile, 5, 5)}
 assert set(_TILE_FNS) == set(MODEL_GEOMETRY), \
     "every pallas kernel model needs a MODEL_GEOMETRY entry and vice versa"
 
@@ -254,7 +328,8 @@ def _dyn_pallas_step(
 
     Returned jitted fn: ``(chunk0, init[S], base[16], masks[mask_words],
     part[2]=(tb_lo, log_tbc)) -> uint32`` (flat first-hit index or
-    SENTINEL), where ``S`` is the model's state width (md5 4, sha256 8).
+    SENTINEL), where ``S`` is the model's state width (md5 4, sha256 8,
+    sha1 5).
 
     Each grid step evaluates ``inner`` consecutive (sublanes, 128) tiles
     in an on-device ``fori_loop``.  The split matters: sublanes bounds
@@ -381,9 +456,9 @@ def build_pallas_search_step(
     kernel simply extends its sequential TPU grid — the flat index
     already spans ``program_id * tile``, so a larger grid IS the
     multi-sub-batch launch, with no extra machinery.  Requires
-    ``tb_count`` to be a power of two, an implemented model (md5 or
-    sha256), and a single-block tail (the overwhelmingly common
-    configuration); callers fall back to the XLA path otherwise.
+    ``tb_count`` to be a power of two, an implemented model (one with a
+    ``_TILE_FNS`` entry), and a single-block tail (the overwhelmingly
+    common configuration); callers fall back to the XLA path otherwise.
 
     ``sublanes``/``inner`` default to the model's tuned geometry
     (``default_geometry``, which caps interpret-mode sublanes at 8 —

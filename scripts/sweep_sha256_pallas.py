@@ -1,12 +1,15 @@
-"""Sweep the SHA-256 Pallas kernel tile geometry on the real chip.
+"""Sweep a Pallas hash-kernel tile geometry on the real chip.
 
-Usage: python scripts/sweep_sha256_pallas.py [--quick]
+Usage: python scripts/sweep_sha256_pallas.py [--quick] [--model NAME]
 
 Measures candidates/sec for (sublanes, inner) combinations at the
 serving launch shape (width-4 chunks, full 256-byte partition,
 difficulty 8 nibbles) and prints a ranked table plus the XLA serving
 rate for reference.  Feed the winner back into
-``ops/md5_pallas.py MODEL_GEOMETRY['sha256']``.
+``ops/md5_pallas.py MODEL_GEOMETRY[model]``.  Default model: sha256
+(the sweep that shipped (16, 1024), docs/KERNELS.md); ``--model sha1``
+sweeps the round-3 SHA-1 kernel, whose shipped geometry is by analogy
+only and unswept.
 """
 
 from __future__ import annotations
@@ -24,6 +27,12 @@ def rate_of(step_builder, label: str):
 
 def main() -> None:
     quick = "--quick" in sys.argv
+    model = "sha256"
+    if "--model" in sys.argv:
+        idx = sys.argv.index("--model") + 1
+        if idx >= len(sys.argv) or sys.argv[idx].startswith("-"):
+            sys.exit("--model needs a value (a _TILE_FNS model name)")
+        model = sys.argv[idx]
     import jax
 
     print(f"devices: {jax.devices()}", file=sys.stderr)
@@ -53,7 +62,7 @@ def main() -> None:
     k = launch_steps_for(4, chunks, 256, 1 << 28)
 
     def xla_builder():
-        step = cached_search_step(nonce, 4, 8, 0, 256, chunks, "sha256",
+        step = cached_search_step(nonce, 4, 8, 0, 256, chunks, model,
                                   b"", k)
         return step, chunks * 256 * k
 
@@ -80,7 +89,7 @@ def main() -> None:
                             k_sl=k_sl):
                     step = build_pallas_search_step(
                         nonce, 4, 8, 0, 256, chunks_sl,
-                        model_name="sha256",
+                        model_name=model,
                         sublanes=sl, inner=inner, launch_steps=k_sl,
                     )
                     return step, chunks_sl * 256 * k_sl
@@ -97,7 +106,7 @@ def main() -> None:
         r, sl, inner = results[0]
         print(f"\nbest: sublanes={sl} inner={inner} -> {r / 1e6:.1f} MH/s "
               f"({r / xla:.2f}x the XLA serving step)")
-        print("update ops/md5_pallas.py MODEL_GEOMETRY['sha256'] = "
+        print(f"update ops/md5_pallas.py MODEL_GEOMETRY[{model!r}] = "
               f"({sl}, {inner}) if this beats the current entry")
 
 

@@ -87,12 +87,30 @@ def test_pallas_backend_end_to_end():
     assert secret == puzzle.python_search(nonce, 2, tbs)
 
 
-def test_pallas_backend_falls_back_for_model_without_kernel():
-    # sha1 has no _TILE_FNS entry -> transparent XLA fallback, same
-    # enumeration order as the oracle
+def test_pallas_backend_serves_sha1_with_kernel():
+    # sha1 has a _TILE_FNS entry since round 3 — served by the kernel,
+    # reference enumeration order
     backend = PallasBackend(hash_model="sha1", batch_size=1 << 14,
                             interpret=True)
     nonce = b"\x11\x22"
+    secret = backend.search(nonce, 2, list(range(256)))
+    assert secret == puzzle.python_search(nonce, 2, list(range(256)),
+                                          algo="sha1")
+
+
+def test_pallas_backend_falls_back_for_model_without_kernel(monkeypatch):
+    # a registry model WITHOUT a kernel entry -> transparent XLA
+    # fallback (all three shipped models have kernels now, so the
+    # branch is exercised by deleting one)
+    from distpow_tpu.ops import md5_pallas
+
+    monkeypatch.delitem(md5_pallas._TILE_FNS, "sha1")
+    backend = PallasBackend(hash_model="sha1", batch_size=1 << 14,
+                            interpret=True)
+    # different nonce from the kernel test above: the layout-keyed
+    # program cache would otherwise return the already-built kernel
+    # step without ever consulting the patched _TILE_FNS
+    nonce = b"\x33\x44"
     secret = backend.search(nonce, 2, list(range(256)))
     assert secret == puzzle.python_search(nonce, 2, list(range(256)),
                                           algo="sha1")
@@ -211,6 +229,61 @@ def test_sha256_pallas_kernel_matches_xla_step():
         interpret=True
     )
     step_x = build_search_step(nonce, 1, 2, 0, 256, 8, SHA256)
+    for c0 in (1, 17):
+        assert int(step_p(jnp.uint32(c0))) == int(step_x(jnp.uint32(c0)))
+
+
+def test_sha1_tile_matches_hashlib_all_buckets():
+    """The SHA-1 tile's single-chain form and its seam handling (rounds
+    0-4 draw from raw init words) must reproduce hashlib's digest words
+    for every mask-word DCE bucket (1-5)."""
+    import hashlib
+    import struct
+
+    import numpy as np
+
+    from distpow_tpu.models.sha1_jax import SHA1_INIT
+    from distpow_tpu.ops.md5_pallas import _sha1_tile
+
+    rng = np.random.default_rng(11)
+    SL, LN = 8, 16
+    msgs = [rng.integers(0, 256, 9, dtype=np.uint8).tobytes()
+            for _ in range(SL * LN)]
+    words = []
+    for g in range(16):
+        arr = np.zeros((SL, LN), np.uint32)
+        for i, m in enumerate(msgs):
+            blk = bytearray(64)
+            blk[:9] = m
+            blk[9] = 0x80
+            blk[56:64] = (72).to_bytes(8, "big")
+            arr[i // LN, i % LN] = struct.unpack(">16I", bytes(blk))[g]
+        words.append(jnp.asarray(arr))
+    init = [jnp.uint32(x) for x in SHA1_INIT]
+    refs = [struct.unpack(">5I", hashlib.sha1(m).digest()) for m in msgs]
+    for mw in range(1, 6):
+        out = _sha1_tile(words, init, mw)
+        assert sum(o is None for o in out) == 5 - mw
+        for j, o in enumerate(out):
+            if o is None:
+                continue
+            o = np.asarray(o)
+            for i, r in enumerate(refs):
+                assert int(o[i // LN, i % LN]) == r[j], (mw, j, i)
+
+
+def test_sha1_pallas_kernel_matches_xla_step():
+    """Full sha1 kernel in interpret mode vs the XLA step.  Unlike the
+    sha256 tile (80-160s interpret compile), the single-chain form
+    compiles in seconds, so this is not a slow test."""
+    from distpow_tpu.models.registry import SHA1
+
+    nonce = b"\x01\x02\x03\x04"
+    step_p = build_pallas_search_step(
+        nonce, 1, 2, 0, 256, 8, model_name="sha1", sublanes=8,
+        interpret=True
+    )
+    step_x = build_search_step(nonce, 1, 2, 0, 256, 8, SHA1)
     for c0 in (1, 17):
         assert int(step_p(jnp.uint32(c0))) == int(step_x(jnp.uint32(c0)))
 
