@@ -245,75 +245,97 @@ def main() -> None:
         ),
     }
 
+    # One pallas builder import for the three kernel benches; None =
+    # pallas unavailable on this backend, each block then skips itself.
     try:
         from distpow_tpu.ops.md5_pallas import build_pallas_search_step
-
-        def pallas_builder():
-            # same launch amortization as the XLA paths: k sub-batches
-            # per dispatch via the kernel's extended sequential grid
-            step = build_pallas_search_step(
-                nonce, 4, difficulty, 0, 256, chunks, launch_steps=k
-            )
-            return step, chunks * 256 * k
-
-        rates["pallas"] = device_rate(pallas_builder, f"pallas kernel, k={k}")
-    except Exception as exc:  # pallas unsupported on this backend
+    except Exception as exc:
         print(f"[bench] pallas path unavailable: {exc}", file=sys.stderr)
+        build_pallas_search_step = None
+    # launch multiplier shared by the slower-hash benches (1<<28 budget
+    # vs the md5 benches' 1<<30: same wall time per timed window)
+    k28 = launch_steps_for(4, chunks, 256, 1 << 28)
+
+    if build_pallas_search_step is not None:
+        try:
+            def pallas_builder():
+                # same launch amortization as the XLA paths: k
+                # sub-batches per dispatch via the kernel's extended
+                # sequential grid
+                step = build_pallas_search_step(
+                    nonce, 4, difficulty, 0, 256, chunks, launch_steps=k
+                )
+                return step, chunks * 256 * k
+
+            rates["pallas"] = device_rate(
+                pallas_builder, f"pallas kernel, k={k}"
+            )
+        except Exception as exc:
+            print(f"[bench] pallas bench failed: {exc}", file=sys.stderr)
 
     # SHA-256 serving rate (north-star hash; VERDICT r1 item 7)
     try:
-        sha = get_hash_model("sha256")
-        k_sha = launch_steps_for(4, chunks, 256, 1 << 28)
-
         def sha_builder():
             step = cached_search_step(
-                nonce, 4, difficulty, 0, 256, chunks, sha.name, b"", k_sha
+                nonce, 4, difficulty, 0, 256, chunks, "sha256", b"", k28
             )
-            return step, chunks * 256 * k_sha
+            return step, chunks * 256 * k28
 
         rates["sha256-serving"] = device_rate(
-            sha_builder, f"sha256 serving step, k={k_sha}"
+            sha_builder, f"sha256 serving step, k={k28}"
         )
     except Exception as exc:
         print(f"[bench] sha256 serving bench failed: {exc}", file=sys.stderr)
 
-    # SHA-1 serving rate (third registry model — diagnostic only; the
-    # headline and utilization lines stay md5/sha256)
-    try:
-        k_s1 = launch_steps_for(4, chunks, 256, 1 << 28)
+    # SHA-256 Pallas kernel (round 3): explicit tile geometry (swept
+    # MODEL_GEOMETRY default) to dodge the register spills capping the
+    # XLA fusion at ~77% of the measured roofline (docs/KERNELS.md)
+    if build_pallas_search_step is not None:
+        try:
+            def sha_pallas_builder():
+                step = build_pallas_search_step(
+                    nonce, 4, difficulty, 0, 256, chunks,
+                    model_name="sha256", launch_steps=k28,
+                )
+                return step, chunks * 256 * k28
 
+            rates["sha256-pallas"] = device_rate(
+                sha_pallas_builder, f"sha256 pallas kernel, k={k28}"
+            )
+        except Exception as exc:
+            print(f"[bench] sha256 pallas bench failed: {exc}",
+                  file=sys.stderr)
+
+    # SHA-1 serving + kernel rates (third registry model — diagnostic
+    # only; the headline and utilization lines stay md5/sha256)
+    try:
         def sha1_builder():
             step = cached_search_step(
-                nonce, 4, difficulty, 0, 256, chunks, "sha1", b"", k_s1
+                nonce, 4, difficulty, 0, 256, chunks, "sha1", b"", k28
             )
-            return step, chunks * 256 * k_s1
+            return step, chunks * 256 * k28
 
         rates["sha1-serving"] = device_rate(
-            sha1_builder, f"sha1 serving step, k={k_s1}"
+            sha1_builder, f"sha1 serving step, k={k28}"
         )
     except Exception as exc:
         print(f"[bench] sha1 serving bench failed: {exc}", file=sys.stderr)
 
-    # SHA-256 Pallas kernel (round 3): explicit tile geometry (swept
-    # MODEL_GEOMETRY default) to dodge the register spills capping the
-    # XLA fusion at ~77% of the measured roofline (docs/KERNELS.md)
-    try:
-        from distpow_tpu.ops.md5_pallas import build_pallas_search_step as _bps
+    if build_pallas_search_step is not None:
+        try:
+            def sha1_pallas_builder():
+                step = build_pallas_search_step(
+                    nonce, 4, difficulty, 0, 256, chunks,
+                    model_name="sha1", launch_steps=k28,
+                )
+                return step, chunks * 256 * k28
 
-        k_shp = launch_steps_for(4, chunks, 256, 1 << 28)
-
-        def sha_pallas_builder():
-            step = _bps(
-                nonce, 4, difficulty, 0, 256, chunks,
-                model_name="sha256", launch_steps=k_shp,
+            rates["sha1-pallas"] = device_rate(
+                sha1_pallas_builder, f"sha1 pallas kernel, k={k28}"
             )
-            return step, chunks * 256 * k_shp
-
-        rates["sha256-pallas"] = device_rate(
-            sha_pallas_builder, f"sha256 pallas kernel, k={k_shp}"
-        )
-    except Exception as exc:
-        print(f"[bench] sha256 pallas bench failed: {exc}", file=sys.stderr)
+        except Exception as exc:
+            print(f"[bench] sha1 pallas bench failed: {exc}",
+                  file=sys.stderr)
 
     # Utilization vs a MEASURED VPU integer roofline (VERDICT r2 weak #4:
     # round 2's 7.7 Tops/s denominator was back-derived from the hash
