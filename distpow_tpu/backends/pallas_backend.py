@@ -19,7 +19,8 @@ from ..ops.md5_pallas import (
     default_geometry,
 )
 from ..ops.search_step import cached_search_step
-from ..parallel.search import contiguous_bounds, search
+from ..parallel.partition import contiguous_bounds
+from ..parallel.search import search
 
 
 class PallasBackend:

@@ -1,4 +1,24 @@
-from . import puzzle
-from .registry import MD5, SHA256, HashModel, get_hash_model, register_hash_model
+"""Hash models: pure-Python puzzle oracle + pluggable JAX hash registry.
 
-__all__ = ["puzzle", "MD5", "SHA256", "HashModel", "get_hash_model", "register_hash_model"]
+The registry (and through it the ``*_jax`` modules) imports jax, so it
+is exposed lazily via module ``__getattr__`` (PEP 562): jax-free
+consumers — the native C++ backend, the runtime layer, the CLI parsers —
+can ``from ..models import puzzle`` without pulling the JAX compute path
+into their import graph (advisor r3, backends/native_miner.py).
+"""
+
+from . import puzzle
+
+_REGISTRY_EXPORTS = (
+    "MD5", "SHA256", "HashModel", "get_hash_model", "register_hash_model",
+)
+
+__all__ = ["puzzle", *_REGISTRY_EXPORTS]
+
+
+def __getattr__(name):
+    if name in _REGISTRY_EXPORTS:
+        from . import registry
+
+        return getattr(registry, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -385,6 +385,7 @@ class Worker:
         self.bound_addr: Optional[str] = None
         self._forwarder: Optional[threading.Thread] = None
         self._stopping = threading.Event()
+        self._start_warmup(backend)
         hang_timeout = float(getattr(config, "DeviceHangTimeoutS", 0.0) or 0.0)
         if hang_timeout > 0:
             # a hung accelerator dispatch makes this worker a zombie the
@@ -394,13 +395,15 @@ class Worker:
             # runtime/watchdog.py.  Refcounted: in-process multi-worker
             # harnesses share one clock (first timeout wins), and it
             # stops when the last armed worker shuts down.  Armed LAST,
-            # after every fallible constructor step: an init failure
-            # must not leak a ref the matching shutdown() will never
-            # release (and nothing earlier runs inside an active()
-            # section, so arming earlier would protect nothing).
+            # after every fallible constructor step INCLUDING
+            # _start_warmup (advisor r3: a malformed WarmupNonceLens
+            # raising after the acquire would leak the ref forever): an
+            # init failure must not leak a ref the matching shutdown()
+            # will never release.  The warmup thread racing ahead of the
+            # acquire is covered because active() counts even while the
+            # watchdog is stopped (watchdog.py active()).
             WATCHDOG.acquire(hang_timeout)
             self._armed_watchdog = True
-        self._start_warmup(backend)
 
     def _start_warmup(self, backend) -> None:
         """Background-compile the layout-keyed search programs at boot so

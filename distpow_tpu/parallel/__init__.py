@@ -1,8 +1,38 @@
-from .partition import remainder_bits, split_thread_bytes, thread_bytes, worker_bits
-from .search import SearchResult, search
-from .mesh_search import make_mesh, search_mesh
+"""Parallel search: partition algebra (jax-free) + device search drivers.
+
+``search``/``mesh_search`` import jax, so they are exposed lazily via
+module ``__getattr__`` (PEP 562) — jax-free consumers (the native C++
+backend, runtime, CLI parsers) can use the partition algebra without
+pulling the JAX compute path into their import graph (advisor r3; same
+pattern as models/__init__.py).
+"""
+
+from .partition import (  # noqa: F401
+    contiguous_bounds,
+    remainder_bits,
+    split_thread_bytes,
+    thread_bytes,
+    worker_bits,
+)
+
+_LAZY = {
+    "SearchResult": "search",
+    "search": "search",
+    "make_mesh": "mesh_search",
+    "search_mesh": "mesh_search",
+}
 
 __all__ = [
-    "remainder_bits", "split_thread_bytes", "thread_bytes", "worker_bits",
+    "contiguous_bounds", "remainder_bits", "split_thread_bytes",
+    "thread_bytes", "worker_bits",
     "SearchResult", "search", "make_mesh", "search_mesh",
 ]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

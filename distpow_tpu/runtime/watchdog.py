@@ -139,10 +139,16 @@ class DeviceWatchdog:
     @contextmanager
     def active(self):
         """Mark the enclosing block as device-driving.  Nestable and
-        concurrency-safe (a counter, not a flag)."""
-        if self._thread is None:
-            yield
-            return
+        concurrency-safe (a counter, not a flag).
+
+        Counts unconditionally — NOT only while the monitor runs — so a
+        section already in flight when a later ``start()``/``acquire()``
+        arms the watchdog is covered for the rest of its duration
+        (advisor r3: the old early-return left such sections permanently
+        invisible).  ``start()`` re-seeds ``_last_beat``, so arming over
+        an already-hung section fires one full timeout later; beats stay
+        no-ops while stopped, and the per-section lock cost is paid once
+        per search, not per beat."""
         with self._lock:
             self._active += 1
             self._last_beat = monotonic()

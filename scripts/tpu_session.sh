@@ -1,15 +1,19 @@
 #!/usr/bin/env bash
 # One uninterrupted TPU work session: waits for the device, then runs
-# (1) the full bench, (2) the config-5 process-level run, and (3) the
-# full sha256 kernel geometry sweep — sequentially, in one process
-# tree, with NO kills in between (interrupting an active TPU client has
-# twice left the tunnel unresponsive for hours; see
-# docs/KERNELS.md + BASELINE.md provenance notes).
-# Usage: scripts/tpu_session.sh [outdir]   (default /tmp/tpu_session)
+# the round-4 hardware queue in value order — (1) the full bench,
+# (2) the config-5 process-level run, (3) the pallas parity
+# distribution, (4) the sha1 kernel geometry sweep, (5) the full sha256
+# sweep — sequentially, in one process tree, with NO kills in between
+# (interrupting an active TPU client has twice left the tunnel
+# unresponsive for hours; see docs/KERNELS.md + BASELINE.md provenance
+# notes).  Output goes INSIDE the repo (docs/artifacts/) so every
+# number lands in a committable file (VERDICT r3 item 2: round 3's raw
+# sweep log lived in /tmp and was lost with the machine).
+# Usage: scripts/tpu_session.sh [outdir]   (default docs/artifacts/r4)
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-OUT="${1:-/tmp/tpu_session}"
+OUT="${1:-docs/artifacts/r4}"
 mkdir -p "$OUT"
 
 echo "=== waiting for device ($(date +%T)) ===" | tee "$OUT/session.log"
@@ -38,8 +42,9 @@ fi
 
 # Stage order = value per TPU-minute: the headline bench first (the
 # 2026-07-29/30 outages both struck mid-session; whatever runs first is
-# whatever gets measured), then the process-level config-5 drive, then
-# the open-ended geometry sweep last.
+# whatever gets measured), then the process-level config-5 drive
+# (VERDICT r3 #3), the pallas parity distribution (#5), the sha1
+# geometry sweep (#4), and the open-ended full sha256 sweep last (#2).
 echo "=== full bench ===" | tee -a "$OUT/session.log"
 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
 cat "$OUT/bench.json" | tee -a "$OUT/session.log"
@@ -48,8 +53,16 @@ echo "=== config-5 TPU-backed process run ===" | tee -a "$OUT/session.log"
 bash scripts/run_config5_tpu.sh 6 "$OUT/config5" >"$OUT/config5.log" 2>&1
 grep -E "MineResult|violation|wall-clock|warmup" "$OUT/config5.log" | tee -a "$OUT/session.log"
 
+echo "=== pallas parity distribution (12 fresh nonces) ===" | tee -a "$OUT/session.log"
+python scripts/parity_pallas.py 12 >"$OUT/parity.json" 2>"$OUT/parity.log"
+cat "$OUT/parity.json" | tee -a "$OUT/session.log"
+
+echo "=== sha1 kernel sweep ===" | tee -a "$OUT/session.log"
+python scripts/sweep_sha256_pallas.py --model sha1 >"$OUT/sweep_sha1.log" 2>&1
+tail -12 "$OUT/sweep_sha1.log" | tee -a "$OUT/session.log"
+
 echo "=== sha256 kernel sweep (full) ===" | tee -a "$OUT/session.log"
-python scripts/sweep_sha256_pallas.py >"$OUT/sweep.log" 2>&1
-tail -12 "$OUT/sweep.log" | tee -a "$OUT/session.log"
+python scripts/sweep_sha256_pallas.py >"$OUT/sweep_sha256.log" 2>&1
+tail -12 "$OUT/sweep_sha256.log" | tee -a "$OUT/session.log"
 
 echo "=== done $(date +%T) ===" | tee -a "$OUT/session.log"

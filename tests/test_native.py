@@ -157,3 +157,45 @@ def test_native_backend_hash_accounting():
     assert puzzle.python_search(b"\x01\x02", 3, list(range(256)),
                                 on_progress=on_progress) == secret
     assert counted == oracle_count
+
+
+def test_native_digest_bytes_agree_with_registry():
+    """The local DIGEST_BYTES table (which keeps jax out of the native
+    import graph, advisor r3) must never drift from the registry."""
+    from distpow_tpu.models.registry import get_hash_model
+
+    for name, nbytes in native.DIGEST_BYTES.items():
+        model = get_hash_model(name)
+        assert model.digest_bytes == nbytes
+        assert model.max_difficulty == 2 * nbytes
+    assert set(native.DIGEST_BYTES) == set(native.ALGO_IDS)
+
+
+def test_native_backend_importable_without_jax():
+    """Native-only deployments (jax absent) must be able to import and
+    run the C++ backend: the whole import graph of
+    backends.native_miner is jax-free (advisor r3; models/__init__ and
+    parallel/__init__ expose their jax halves lazily via PEP 562)."""
+    import subprocess
+    import sys as _sys
+
+    code = """
+import sys
+for m in [m for m in sys.modules if m == "jax" or m.startswith(("jax.", "jaxlib"))]:
+    del sys.modules[m]
+class Block:
+    def find_spec(self, name, path=None, target=None):
+        if name == "jax" or name.startswith(("jax.", "jaxlib")):
+            raise ImportError("jax blocked: " + name)
+sys.meta_path.insert(0, Block())
+from distpow_tpu.backends import native_miner
+for algo in ("md5", "sha256", "sha1"):
+    s = native_miner.NativeBackend(algo).search(
+        b"\\x01\\x02\\x03\\x04", 3, list(range(256)))
+    assert s is not None, algo
+print("JAXFREE_OK")
+"""
+    out = subprocess.run([_sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "JAXFREE_OK" in out.stdout

@@ -132,7 +132,15 @@ def test_search_step_sha256():
 from distpow_tpu.ops.search_step import _dyn_search_step, cached_search_step
 
 
-@pytest.mark.parametrize("model", [MD5, SHA256, SHA1])
+# sha256/sha1 parametrizations are `slow` (VERDICT r3 item 8: XLA:CPU
+# compiles of their unrolled compress dominate the default suite);
+# md5 keeps dyn-vs-static parity in the fast path, and the sha models'
+# parity still gates the full run.
+@pytest.mark.parametrize("model", [
+    MD5,
+    pytest.param(SHA256, marks=pytest.mark.slow),
+    pytest.param(SHA1, marks=pytest.mark.slow),
+])
 @pytest.mark.parametrize("nonce_len,width", [(2, 1), (4, 2), (63, 1), (70, 2)])
 def test_dyn_step_matches_static(model, nonce_len, width):
     rng = random.Random(nonce_len * 31 + width)

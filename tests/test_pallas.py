@@ -288,6 +288,7 @@ def test_sha1_pallas_kernel_matches_xla_step():
         assert int(step_p(jnp.uint32(c0))) == int(step_x(jnp.uint32(c0)))
 
 
+@pytest.mark.slow
 def test_pallas_mesh_matches_jax_mesh_all_partitions():
     """pallas-mesh must be bit-identical to jax-mesh in both sharding
     regimes (tb-split, chunk-split) and on sub-partitions — both return
@@ -332,6 +333,7 @@ def test_pallas_mesh_warmup_covers_serving_compile_keys():
     assert _dyn_pallas_mesh_step.cache_info().misses == misses
 
 
+@pytest.mark.slow
 def test_pallas_mask_word_buckets_match_xla():
     # difficulties spanning all four trailing-word buckets exercise the
     # skipped-final-rounds DCE (mw=1 skips rounds 62-63, mw=2 skips 63)

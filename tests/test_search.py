@@ -79,6 +79,21 @@ def test_search_unsatisfiable_difficulty_returns_on_cancel():
     assert got is None
 
 
+def test_search_unsatisfiable_difficulty_without_gate_raises():
+    """Bare library callers get a ValueError instead of an un-endable
+    wait (VERDICT r3 item 7); the worker path always passes a
+    cancel_check, so serving behavior (block-until-cancel, reference
+    parity with worker.go:246-256) is unchanged."""
+    with pytest.raises(ValueError, match="unsatisfiable"):
+        search(b"\x01", 33, list(range(256)))
+
+    from distpow_tpu.backends import native_miner
+
+    backend = native_miner.NativeBackend()
+    with pytest.raises(ValueError, match="unsatisfiable"):
+        backend.search(b"\x01", 33, list(range(256)))
+
+
 def test_search_sha256_model():
     nonce = b"\x0a\x0b"
     tbs = list(range(256))

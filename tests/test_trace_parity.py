@@ -141,3 +141,82 @@ def test_shiviz_output_matches_published_parser_spec():
         assert clock[host] == last_clock.get(host, 0) + 1
         last_clock[host] = clock[host]
         assert pairs[i + 1].strip(), "empty description line"
+
+
+def test_shiviz_clock_lines_byte_match_govector_golden():
+    """Byte-for-byte golden-shape diff against the published GoVector
+    format (VERDICT r3 item 6).
+
+    The golden below is hand-derived from GoVector's documented log
+    entry shape — ``pid vcstring\\nmessage\\n`` where vcstring is
+    ``vclock.ReturnVCString()``: ids sorted lexicographically,
+    ``"id":count`` pairs joined by ", " inside braces (e.g.
+    ``{"alpha":2, "beta":1}``) — the format the reference's tracing
+    server writes into shiviz_output.log via govec
+    (cmd/tracing-server/main.go:10-17,
+    config/tracing_server_config.json:4-5).  The full ``pid vcstring``
+    clock line must diff CLEAN against a GoVector log.
+
+    Irreducible divergences, documented: (a) this server writes the
+    ShiViz parser regex as a 2-line file header — GoVector raw logs
+    carry no header (strip 2 lines to compare whole files); (b) the
+    event-description line renders the action body as JSON
+    (``CacheMiss {"Nonce": [1], ...}``) where Go's fmt "%+v" renders
+    ``{Nonce:[1] ...}`` — ShiViz treats the description as opaque text,
+    and the Go rendering is unreproducible without fixing every
+    downstream type's String(); (c) GoVector logs open with an
+    "Initialization Complete" entry at clock {pid:1} — the tracing-layer
+    equivalent is the first real event, since the reference tracing lib
+    (not raw govec) also skips a dedicated init line per its
+    trace_output.log samples."""
+    import os
+    import tempfile
+
+    from distpow_tpu.runtime.actions import CacheMiss
+    from distpow_tpu.runtime.config import TracingServerConfig
+    from distpow_tpu.runtime.trace_server import TracingServer, govector_vc_string
+
+    d = tempfile.mkdtemp()
+    cfg = TracingServerConfig(
+        ServerBind="127.0.0.1:0",
+        Secret=b"",
+        OutputFile=os.path.join(d, "trace_output.log"),
+        ShivizOutputFile=os.path.join(d, "shiviz_output.log"),
+    )
+    server = TracingServer(cfg)
+
+    class DirectSink:
+        def emit(self, event):
+            server._handle_event(event)
+
+        def close(self):
+            pass
+
+    # two-host token exchange: alpha acts, hands causality to beta
+    alpha = Tracer("alpha", DirectSink())
+    beta = Tracer("beta", DirectSink())
+    t = alpha.create_trace()
+    t.record_action(CacheMiss(nonce=b"\x01", num_trailing_zeros=3))
+    tok = t.generate_token()
+    t2 = beta.receive_token(tok)
+    t2.record_action(CacheMiss(nonce=b"\x01", num_trailing_zeros=3))
+    tok2 = t2.generate_token()
+    alpha.receive_token(tok2)
+    server.close()
+
+    lines = open(cfg.ShivizOutputFile).read().split("\n")
+    clock_lines = [ln for ln in lines[2:] if ln][0::2]  # skip header; evens
+    golden = [
+        'alpha {"alpha":1}',                       # CacheMiss
+        'alpha {"alpha":2}',                       # generate_token
+        'beta {"alpha":2, "beta":1}',              # receive_token (merge)
+        'beta {"alpha":2, "beta":2}',              # CacheMiss
+        'beta {"alpha":2, "beta":3}',              # generate_token
+        'alpha {"alpha":3, "beta":3}',             # receive_token (merge)
+    ]
+    assert clock_lines == golden
+
+    # and the formatter alone round-trips a published GoVector sample
+    assert govector_vc_string({"beta": 1, "alpha": 2}) == \
+        '{"alpha":2, "beta":1}'
+    assert govector_vc_string({"solo": 7}) == '{"solo":7}'
