@@ -105,3 +105,77 @@ def test_stress_trace_invariants_hold(tmp_path):
         server.close()
     assert check_trace_log(str(out)) == []
     assert check_shiviz_log(str(shiviz)) == []
+
+
+def test_stress_chaos_worker_death_reassign_journal(tmp_path):
+    """Three subsystems under one adversarial load (round 4): concurrent
+    overlapping clients + a worker killed MID-load with
+    FailurePolicy="reassign" + a live cache journal.  Afterwards: every
+    request completed with a valid secret (hammer asserts), the trace
+    oracle is violation-free, per-task state drained, and a FRESH cache
+    replayed from the journal satisfies every requested nonce —
+    i.e. failure recovery, checkpoint/resume, and tracing compose."""
+    cache_file = str(tmp_path / "cache.jsonl")
+    out = tmp_path / "trace_output.log"
+    shiviz = tmp_path / "shiviz_output.log"
+    server = TracingServer(TracingServerConfig(
+        ServerBind="127.0.0.1:0", Secret=b"",
+        OutputFile=str(out), ShivizOutputFile=str(shiviz),
+    ))
+    addr = server.open()
+    server.accept_in_background()
+    s = Stack(3, failure_policy="reassign", failure_probe_secs=0.2,
+              coord_cache_file=cache_file,
+              sink_factory=lambda name: TCPSink(addr, b""))
+    killed = threading.Event()
+
+    def killer():
+        # deterministically land inside the storm: wait for a LIVE task
+        # (a Mine in flight), then kill — not a fixed sleep, which can
+        # fire after the low-difficulty storm has already drained
+        deadline = time.time() + 10
+        while time.time() < deadline and not s.coordinator.handler._tasks:
+            time.sleep(0.002)
+        s.workers[2].server.shutdown()  # inbound RPCs now fail
+        killed.set()
+
+    threading.Thread(target=killer, daemon=True).start()
+    try:
+        hammer(s, n_clients=5, requests_per_client=3, seed=0x50)
+        assert killed.wait(10)
+        # a FRESH post-kill nonce must fan out into the dead worker and
+        # come back anyway — the reassignment path, exercised
+        # unconditionally (the storm may or may not have covered it)
+        late = s.new_client("client_late")
+        late.mine(bytes([0x51, 9]), 2)
+        res = late.notify_queue.get(timeout=60)
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+            s.coordinator.handler._tasks
+            or any(w.handler._tasks for w in s.workers[:2])
+        ):
+            time.sleep(0.05)
+        assert s.coordinator.handler._tasks == {}
+        assert s.coordinator.handler._key_locks == {}
+        for w in s.workers[:2]:
+            assert w.handler._tasks == {}
+    finally:
+        s.close()
+        time.sleep(0.5)
+        server.close()
+
+    assert check_trace_log(str(out)) == []
+    assert check_shiviz_log(str(shiviz)) == []
+
+    # checkpoint/resume: a coordinator restarted on this journal serves
+    # every nonce the storm mined straight from cache (dominance covers
+    # the lower difficulty of each overlapped pair)
+    from distpow_tpu.runtime.cache import ResultCache
+
+    replay = ResultCache(persist_path=cache_file)
+    for k in range(3):
+        nonce = bytes([0x50, k])
+        secret = replay.satisfies(nonce, 1)
+        assert secret is not None, f"journal lost nonce {nonce.hex()}"
+        assert puzzle.check_secret(nonce, secret, 1)

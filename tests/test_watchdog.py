@@ -195,3 +195,28 @@ def test_hung_worker_process_dies_and_request_completes(tmp_path):
         assert rc == EXIT_CODE, (rc, hang_child.stdout.read())
     finally:
         stack.close()
+
+
+def test_section_in_flight_before_arming_is_covered(dog):
+    """A device section entered while the watchdog is STOPPED must still
+    count once a later start()/acquire() arms the monitor (advisor r3:
+    the old early-return in active() left such sections permanently
+    invisible — e.g. a search already dispatching when a worker boots
+    and arms, or when bench/sweep call start())."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hung_section():
+        with dog.active():          # watchdog not running yet
+            entered.set()
+            release.wait(5.0)       # simulates a dispatch that never beats
+
+    t = threading.Thread(target=hung_section, daemon=True)
+    t.start()
+    assert entered.wait(2.0)
+    assert dog._active == 1         # counted even while stopped
+    dog.start(0.2, on_hang=lambda s: None)
+    assert dog.fired.wait(2.0), \
+        "pre-armed in-flight section never detected as hung"
+    release.set()
+    t.join(2.0)
