@@ -10,11 +10,12 @@ backend selected by ``WorkerConfig.Backend``:
 * ``jax-mesh`` — shard_map over all local devices, prefix->core
                  (parallel/mesh_search.py)
 * ``pallas``   — hand-written TPU kernels for the hot op
-                 (ops/md5_pallas.py: MD5 + SHA-256) behind the same driver
+                 (ops/md5_pallas.py: every _TILE_FNS model) behind the
+                 same driver
 * ``pallas-mesh`` — the same kernels spread over the local device mesh
                  (prefix->core + ``lax.pmin``, parallel/mesh_search.py)
 * ``native``   — C++ miner via ctypes (backends/native/), the CPU
-                 performance path (MD5 + SHA-256)
+                 performance path (every ALGO_IDS model)
 
 Every backend implements ``search(nonce, difficulty, thread_bytes,
 cancel_check) -> Optional[bytes]`` returning the first solving secret in

@@ -177,6 +177,76 @@ void CompressSha1(uint32_t state[5], const uint8_t block[64]) {
   state[0] += a; state[1] += b; state[2] += c; state[3] += d; state[4] += e;
 }
 
+// --- RIPEMD-160 (ISO/IEC 10118-3; Dobbertin-Bosselaers-Preneel spec) -------
+
+constexpr uint32_t kRmdInit[5] = {0x67452301u, 0xefcdab89u, 0x98badcfeu,
+                                  0x10325476u, 0xc3d2e1f0u};
+// per-16-round-group additive constants, left then right line
+constexpr uint32_t kRmdKL[5] = {0x00000000u, 0x5a827999u, 0x6ed9eba1u,
+                                0x8f1bbcdcu, 0xa953fd4eu};
+constexpr uint32_t kRmdKR[5] = {0x50a28be6u, 0x5c4dd124u, 0x6d703ef3u,
+                                0x7a6d76e9u, 0x00000000u};
+constexpr uint8_t kRmdRL[80] = {
+    0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+    7, 4, 13, 1, 10, 6, 15, 3, 12, 0, 9, 5, 2, 14, 11, 8,
+    3, 10, 14, 4, 9, 15, 8, 1, 2, 7, 0, 6, 13, 11, 5, 12,
+    1, 9, 11, 10, 0, 8, 12, 4, 13, 3, 7, 15, 14, 5, 6, 2,
+    4, 0, 5, 9, 7, 12, 2, 10, 14, 1, 3, 8, 11, 6, 15, 13};
+constexpr uint8_t kRmdRR[80] = {
+    5, 14, 7, 0, 9, 2, 11, 4, 13, 6, 15, 8, 1, 10, 3, 12,
+    6, 11, 3, 7, 0, 13, 5, 10, 14, 15, 8, 12, 4, 9, 1, 2,
+    15, 5, 1, 3, 7, 14, 6, 9, 11, 8, 12, 2, 10, 0, 4, 13,
+    8, 6, 4, 1, 3, 11, 15, 0, 5, 12, 2, 13, 9, 7, 10, 14,
+    12, 15, 10, 4, 1, 5, 8, 7, 6, 2, 13, 14, 0, 3, 9, 11};
+constexpr uint8_t kRmdSL[80] = {
+    11, 14, 15, 12, 5, 8, 7, 9, 11, 13, 14, 15, 6, 7, 9, 8,
+    7, 6, 8, 13, 11, 9, 7, 15, 7, 12, 15, 9, 11, 7, 13, 12,
+    11, 13, 6, 7, 14, 9, 13, 15, 14, 8, 13, 6, 5, 12, 7, 5,
+    11, 12, 14, 15, 14, 15, 9, 8, 9, 14, 5, 6, 8, 6, 5, 12,
+    9, 15, 5, 11, 6, 8, 13, 12, 5, 12, 13, 14, 11, 8, 5, 6};
+constexpr uint8_t kRmdSR[80] = {
+    8, 9, 9, 11, 13, 15, 15, 5, 7, 7, 8, 11, 14, 14, 12, 6,
+    9, 13, 15, 7, 12, 8, 9, 11, 7, 7, 12, 7, 6, 15, 13, 11,
+    9, 7, 15, 11, 8, 6, 6, 14, 12, 13, 5, 14, 13, 13, 7, 5,
+    15, 5, 8, 11, 14, 14, 6, 14, 6, 9, 12, 9, 12, 5, 15, 8,
+    8, 5, 12, 9, 12, 5, 14, 6, 8, 13, 6, 5, 15, 13, 11, 11};
+
+inline uint32_t RmdF(int j, uint32_t x, uint32_t y, uint32_t z) {
+  switch (j / 16) {
+    case 0: return x ^ y ^ z;
+    case 1: return (x & y) | (~x & z);
+    case 2: return (x | ~y) ^ z;
+    case 3: return (x & z) | (y & ~z);
+    default: return x ^ (y | ~z);
+  }
+}
+
+void CompressRipemd160(uint32_t state[5], const uint8_t block[64]) {
+  uint32_t x[16];
+  std::memcpy(x, block, 64);  // little-endian hosts only (matches MD5 path)
+  uint32_t al = state[0], bl = state[1], cl = state[2], dl = state[3],
+           el = state[4];
+  uint32_t ar = al, br = bl, cr = cl, dr = dl, er = el;
+  for (int j = 0; j < 80; ++j) {
+    uint32_t t = Rotl(al + RmdF(j, bl, cl, dl) + x[kRmdRL[j]] +
+                          kRmdKL[j / 16],
+                      kRmdSL[j]) +
+                 el;
+    al = el; el = dl; dl = Rotl(cl, 10); cl = bl; bl = t;
+    // right line runs the round functions in reverse group order
+    t = Rotl(ar + RmdF(79 - j, br, cr, dr) + x[kRmdRR[j]] + kRmdKR[j / 16],
+             kRmdSR[j]) +
+        er;
+    ar = er; er = dr; dr = Rotl(cr, 10); cr = br; br = t;
+  }
+  const uint32_t t = state[1] + cl + dr;
+  state[1] = state[2] + dl + er;
+  state[2] = state[3] + el + ar;
+  state[3] = state[4] + al + br;
+  state[4] = state[0] + bl + cr;
+  state[0] = t;
+}
+
 // --- hash traits bound into the templated scan loop ------------------------
 
 struct Md5Traits {
@@ -225,6 +295,19 @@ struct Sha1Traits {
       out[4 * i + 2] = static_cast<uint8_t>(state[i] >> 8);
       out[4 * i + 3] = static_cast<uint8_t>(state[i]);
     }
+  }
+};
+
+struct Ripemd160Traits {
+  static constexpr int kStateWords = 5;
+  static constexpr int kDigestBytes = 20;
+  static constexpr bool kBigEndianLength = false;  // MD5-style padding
+  static const uint32_t* Init() { return kRmdInit; }
+  static void Compress(uint32_t* state, const uint8_t* block) {
+    CompressRipemd160(state, block);
+  }
+  static void StoreDigest(const uint32_t* state, uint8_t* out) {
+    std::memcpy(out, state, 20);  // LE word serialization, like MD5
   }
 };
 
@@ -399,7 +482,7 @@ extern "C" {
 // acceptable per the puzzle contract, coordinator.go:202).
 //
 // `algo`: 0 = MD5 (reference parity), 1 = SHA-256 (the north-star hash
-// option), 2 = SHA-1; -2 on any other value.
+// option), 2 = SHA-1, 3 = RIPEMD-160; -2 on any other value.
 int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint32_t difficulty, uint32_t algo,
                          const uint8_t* thread_bytes,
@@ -407,14 +490,15 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint64_t chunk_count, int32_t n_threads,
                          const volatile int32_t* cancel_flag,
                          uint64_t* out_hashes, uint8_t* out_secret) {
-  if (n_tb == 0 || width > 8 || algo > 2) return -2;
+  if (n_tb == 0 || width > 8 || algo > 3) return -2;
   // a difficulty beyond the digest's nibble count would read past the
   // digest buffer in MeetsDifficulty (and the puzzle is unsatisfiable
   // anyway — the JAX paths reject it in nibble_masks)
   const uint32_t max_nibbles =
       2 * (algo == 0   ? Md5Traits::kDigestBytes
            : algo == 1 ? Sha256Traits::kDigestBytes
-                       : Sha1Traits::kDigestBytes);
+           : algo == 2 ? Sha1Traits::kDigestBytes
+                       : Ripemd160Traits::kDigestBytes);
   if (difficulty > max_nibbles) return -2;
   SearchTask task{nonce,        nonce_len,  difficulty,
                   thread_bytes, n_tb,       width,
@@ -426,8 +510,11 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
     SearchRange<Md5Traits>(task, chunk_count, n_threads, &found, &hashes);
   } else if (algo == 1) {
     SearchRange<Sha256Traits>(task, chunk_count, n_threads, &found, &hashes);
-  } else {
+  } else if (algo == 2) {
     SearchRange<Sha1Traits>(task, chunk_count, n_threads, &found, &hashes);
+  } else {
+    SearchRange<Ripemd160Traits>(task, chunk_count, n_threads, &found,
+                                 &hashes);
   }
 
   if (out_hashes) *out_hashes = hashes;
@@ -455,6 +542,10 @@ void distpow_sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
 
 void distpow_sha1(const uint8_t* data, size_t len, uint8_t out[20]) {
   DigestBuffer<Sha1Traits>(data, len, out);
+}
+
+void distpow_ripemd160(const uint8_t* data, size_t len, uint8_t out[20]) {
+  DigestBuffer<Ripemd160Traits>(data, len, out);
 }
 
 }  // extern "C"

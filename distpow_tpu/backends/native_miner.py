@@ -57,7 +57,7 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_search_range.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,          # nonce
             ctypes.c_uint32,                            # difficulty
-            ctypes.c_uint32,                    # algo (0 md5, 1 sha256, 2 sha1)
+            ctypes.c_uint32,        # algo (0 md5, 1 sha256, 2 sha1, 3 ripemd160)
             ctypes.c_char_p, ctypes.c_size_t,          # thread bytes
             ctypes.c_uint32,                            # width
             ctypes.c_uint64, ctypes.c_uint64,          # chunk start/count
@@ -78,18 +78,22 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_sha1.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.distpow_ripemd160.restype = None
+        lib.distpow_ripemd160.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
         _lib = lib
         return lib
 
 
-ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2}
+ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2, "ripemd160": 3}
 
 # Digest sizes (bytes) for the native algorithms, fixed by RFC 1321 /
 # FIPS 180-4.  max difficulty = hex nibbles = 2 * digest bytes; kept
 # local (mirroring the C library's own rc=-2 guard) so the native hot
 # path never imports the JAX model modules (advisor r3: resolving
 # max_difficulty via models.registry pulled jax into native-only use).
-DIGEST_BYTES = {"md5": 16, "sha256": 32, "sha1": 20}
+DIGEST_BYTES = {"md5": 16, "sha256": 32, "sha1": 20, "ripemd160": 20}
 
 
 def native_md5(data: bytes) -> bytes:
@@ -110,6 +114,13 @@ def native_sha1(data: bytes) -> bytes:
     lib = load_library()
     out = ctypes.create_string_buffer(20)
     lib.distpow_sha1(data, len(data), out)
+    return out.raw
+
+
+def native_ripemd160(data: bytes) -> bytes:
+    lib = load_library()
+    out = ctypes.create_string_buffer(20)
+    lib.distpow_ripemd160(data, len(data), out)
     return out.raw
 
 

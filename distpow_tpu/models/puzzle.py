@@ -37,9 +37,31 @@ from typing import Callable, Iterator, Optional, Sequence, Tuple
 MAX_DIFFICULTY_MD5 = 32
 
 
+def new_hash(algo: str):
+    """``hashlib.new`` with a pure-Python fallback for ripemd160.
+
+    ripemd160 (round 4's fourth registry model) is the only shipped
+    model outside hashlib's guaranteed set: stock OpenSSL 3 builds
+    without the legacy provider raise ``unsupported hash type`` for it.
+    On such hosts every verification path (and the python parity
+    backend) falls back to the spec-vector-pinned pure-Python
+    implementation — slower, but correct and always available.  All
+    puzzle hashing goes through here so the fallback cannot be
+    bypassed.
+    """
+    try:
+        return hashlib.new(algo)
+    except ValueError:
+        if algo == "ripemd160":
+            from .ripemd160_py import Ripemd160
+
+            return Ripemd160()
+        raise
+
+
 def hash_hex(nonce: bytes, secret: bytes, algo: str = "md5") -> str:
     """Lowercase hex digest of ``algo(nonce + secret)`` (worker.go:353-355)."""
-    h = hashlib.new(algo)
+    h = new_hash(algo)
     h.update(bytes(nonce) + bytes(secret))
     return h.hexdigest()
 
@@ -78,7 +100,7 @@ def check_secret(
     nonce: bytes, secret: bytes, num_trailing_zeros: int, algo: str = "md5"
 ) -> bool:
     """True iff ``secret`` solves the puzzle (worker.go:353-356)."""
-    h = hashlib.new(algo)
+    h = new_hash(algo)
     h.update(bytes(nonce) + bytes(secret))
     return count_trailing_zero_nibbles(h.digest()) >= num_trailing_zeros
 
@@ -181,7 +203,7 @@ def python_search(
         if max_candidates is not None and tried >= max_candidates:
             return done(None, "exhausted")
         tried += 1
-        h = hashlib.new(algo)
+        h = new_hash(algo)
         h.update(nonce)
         h.update(secret)
         if count_trailing_zero_nibbles(h.digest()) >= num_trailing_zeros:

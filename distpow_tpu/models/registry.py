@@ -13,7 +13,7 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Sequence, Tuple
 
-from . import md5_jax, sha1_jax, sha256_jax
+from . import md5_jax, ripemd160_jax, sha1_jax, sha256_jax
 
 
 @dataclass(frozen=True)
@@ -38,7 +38,9 @@ class HashModel:
         return self.digest_bytes * 2
 
     def hashlib_new(self):
-        return hashlib.new(self.name)
+        from . import puzzle
+
+        return puzzle.new_hash(self.name)  # ripemd160 fallback included
 
     def state_to_digest(self, state: Sequence[int]) -> bytes:
         return b"".join(int(w) .to_bytes(4, self.word_byteorder) for w in state)
@@ -80,7 +82,21 @@ SHA1 = HashModel(
     py_absorb=sha1_jax.py_absorb,
 )
 
-_REGISTRY: Dict[str, HashModel] = {"md5": MD5, "sha256": SHA256, "sha1": SHA1}
+RIPEMD160 = HashModel(
+    name="ripemd160",
+    block_bytes=ripemd160_jax.BLOCK_BYTES,
+    digest_words=ripemd160_jax.DIGEST_WORDS,
+    word_byteorder=ripemd160_jax.WORD_BYTEORDER,
+    length_byteorder=ripemd160_jax.LENGTH_BYTEORDER,
+    init_state=ripemd160_jax.RIPEMD160_INIT,
+    compress=ripemd160_jax.ripemd160_compress,
+    py_compress=ripemd160_jax.py_compress,
+    py_absorb=ripemd160_jax.py_absorb,
+)
+
+_REGISTRY: Dict[str, HashModel] = {
+    "md5": MD5, "sha256": SHA256, "sha1": SHA1, "ripemd160": RIPEMD160,
+}
 
 
 def get_hash_model(name: str) -> HashModel:

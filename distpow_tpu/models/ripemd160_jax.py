@@ -1,0 +1,101 @@
+"""RIPEMD-160 as a vectorized JAX computation over uint32 lanes.
+
+Fourth registry model (round 4).  RIPEMD-160 is the classic Merkle-
+Damgard sibling the registry abstraction was built for: MD5's exact
+block/padding layout (64-byte blocks, little-endian 64-bit bit-length
+field, little-endian digest words — worker.go:353's md5.Sum analogue)
+with a different compression function, so every layer above the model —
+packing (ops/packing.py), difficulty masks, search drivers, backends —
+serves it unchanged.  It is also a real-world pick: RIPEMD-160 is the
+second hash in Bitcoin's HASH160, so "mine a RIPEMD-160 puzzle" is not a
+toy ask.
+
+TPU shape: the compression runs two independent 80-round lines (left /
+right) over the same 16 message words; both lines are pure uint32
+add/xor/or/and/rot — VPU-native, and their independence gives the
+scheduler explicit ILP the single-chain MD5/SHA rounds don't have.  The
+whole 160-round graph is unrolled (static; no data-dependent control
+flow) and XLA fuses it into one elementwise kernel, same as the other
+models.
+
+Spec tables and the pure-Python twin (host-side prefix absorption +
+independent oracle + the hashlib fallback shim) live in the jax-free
+``ripemd160_py`` and are re-exported here — one copy of the spec data
+for this module, the Pallas tile, and puzzle.py's fallback.  Pinned
+against ``hashlib.new("ripemd160")`` and the published spec vectors in
+tests/test_hash_models.py.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from .ripemd160_py import (  # noqa: F401  (shared spec data + py twin)
+    BLOCK_BYTES,
+    DIGEST_WORDS,
+    LENGTH_BYTEORDER,
+    RIPEMD160_INIT,
+    WORD_BYTEORDER,
+    _KL,
+    _KR,
+    _MASK,
+    _RL,
+    _RR,
+    _SL,
+    _SR,
+    _f,
+    py_absorb,
+    py_compress,
+    py_digest,
+)
+
+
+def _rotl(x, s: int):
+    x = x.astype(jnp.uint32) if hasattr(x, "astype") else jnp.uint32(x)
+    return (x << s) | (x >> (32 - s))
+
+
+def ripemd160_compress(state, words: Sequence):
+    """One RIPEMD-160 block compression, vectorized.
+
+    ``state`` is a 5-tuple of uint32 arrays/scalars; ``words`` is a
+    sequence of 16 broadcast-compatible uint32 arrays (or Python ints for
+    constant words, which XLA folds together with the round constant —
+    same convention as md5_jax.md5_compress).
+    """
+    h = tuple(jnp.uint32(s) for s in state)
+    al, bl, cl, dl, el = h
+    ar, br, cr, dr, er = h
+    for j in range(80):
+        # left line: functions in forward group order, constants _KL
+        m = words[_RL[j]]
+        fl = _f(j, bl, cl, dl) + al
+        if not hasattr(m, "dtype"):
+            fl = fl + jnp.uint32((_KL[j // 16] + int(m)) & _MASK)
+        elif m.ndim == 0:
+            fl = fl + (jnp.uint32(_KL[j // 16]) + m)
+        else:
+            fl = fl + jnp.uint32(_KL[j // 16]) + m
+        t = _rotl(fl, _SL[j]) + el
+        al, el, dl, cl, bl = el, dl, _rotl(cl, 10), bl, t
+        # right line: functions in REVERSE group order, constants _KR
+        m = words[_RR[j]]
+        fr = _f(79 - j, br, cr, dr) + ar
+        if not hasattr(m, "dtype"):
+            fr = fr + jnp.uint32((_KR[j // 16] + int(m)) & _MASK)
+        elif m.ndim == 0:
+            fr = fr + (jnp.uint32(_KR[j // 16]) + m)
+        else:
+            fr = fr + jnp.uint32(_KR[j // 16]) + m
+        t = _rotl(fr, _SR[j]) + er
+        ar, er, dr, cr, br = er, dr, _rotl(cr, 10), br, t
+    h0, h1, h2, h3, h4 = h
+    return (
+        h1 + cl + dr,
+        h2 + dl + er,
+        h3 + el + ar,
+        h4 + al + br,
+        h0 + bl + cr,
+    )

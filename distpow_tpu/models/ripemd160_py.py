@@ -1,0 +1,156 @@
+"""RIPEMD-160 constants and pure-Python implementation (jax-free).
+
+Split out of ``ripemd160_jax`` (round 4 review) for two consumers that
+must not import jax: ``models/puzzle.py``, which falls back to this
+module when the host's OpenSSL build omits the legacy ripemd160 digest
+(stock OpenSSL 3 without the legacy provider — ripemd160 is the first
+registry model outside hashlib's guaranteed set), and the Pallas tile,
+which shares the round tables.  ``ripemd160_jax`` re-exports everything
+here, so there is exactly ONE copy of the spec data.
+
+Tables and algorithm from the RIPEMD-160 specification (Dobbertin,
+Bosselaers, Preneel; ISO/IEC 10118-3); pinned against the paper's
+Appendix B vectors in tests/test_hash_models.py.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+RIPEMD160_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
+
+BLOCK_BYTES = 64
+DIGEST_WORDS = 5
+WORD_BYTEORDER = "little"
+LENGTH_BYTEORDER = "little"
+
+# Per-16-round-group additive constants (left line then right line).
+_KL = (0x00000000, 0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xA953FD4E)
+_KR = (0x50A28BE6, 0x5C4DD124, 0x6D703EF3, 0x7A6D76E9, 0x00000000)
+
+# Message-word selection order, left line (80 entries).
+_RL = (
+    0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+    7, 4, 13, 1, 10, 6, 15, 3, 12, 0, 9, 5, 2, 14, 11, 8,
+    3, 10, 14, 4, 9, 15, 8, 1, 2, 7, 0, 6, 13, 11, 5, 12,
+    1, 9, 11, 10, 0, 8, 12, 4, 13, 3, 7, 15, 14, 5, 6, 2,
+    4, 0, 5, 9, 7, 12, 2, 10, 14, 1, 3, 8, 11, 6, 15, 13,
+)
+# Message-word selection order, right line.
+_RR = (
+    5, 14, 7, 0, 9, 2, 11, 4, 13, 6, 15, 8, 1, 10, 3, 12,
+    6, 11, 3, 7, 0, 13, 5, 10, 14, 15, 8, 12, 4, 9, 1, 2,
+    15, 5, 1, 3, 7, 14, 6, 9, 11, 8, 12, 2, 10, 0, 4, 13,
+    8, 6, 4, 1, 3, 11, 15, 0, 5, 12, 2, 13, 9, 7, 10, 14,
+    12, 15, 10, 4, 1, 5, 8, 7, 6, 2, 13, 14, 0, 3, 9, 11,
+)
+# Rotation amounts, left line.
+_SL = (
+    11, 14, 15, 12, 5, 8, 7, 9, 11, 13, 14, 15, 6, 7, 9, 8,
+    7, 6, 8, 13, 11, 9, 7, 15, 7, 12, 15, 9, 11, 7, 13, 12,
+    11, 13, 6, 7, 14, 9, 13, 15, 14, 8, 13, 6, 5, 12, 7, 5,
+    11, 12, 14, 15, 14, 15, 9, 8, 9, 14, 5, 6, 8, 6, 5, 12,
+    9, 15, 5, 11, 6, 8, 13, 12, 5, 12, 13, 14, 11, 8, 5, 6,
+)
+# Rotation amounts, right line.
+_SR = (
+    8, 9, 9, 11, 13, 15, 15, 5, 7, 7, 8, 11, 14, 14, 12, 6,
+    9, 13, 15, 7, 12, 8, 9, 11, 7, 7, 12, 7, 6, 15, 13, 11,
+    9, 7, 15, 11, 8, 6, 6, 14, 12, 13, 5, 14, 13, 13, 7, 5,
+    15, 5, 8, 11, 14, 14, 6, 14, 6, 9, 12, 9, 12, 5, 15, 8,
+    8, 5, 12, 9, 12, 5, 14, 6, 8, 13, 6, 5, 15, 13, 11, 11,
+)
+
+_MASK = 0xFFFFFFFF
+
+
+def _f(j: int, x, y, z):
+    """Round function of group ``j // 16`` (left-line order; the right
+    line uses group ``4 - j // 16``).  Polymorphic over Python ints and
+    jnp uint32 arrays — the single copy of the spec's five boolean
+    functions, shared by the int twin, the JAX compress, and the Pallas
+    tile."""
+    g = j // 16
+    if g == 0:
+        return x ^ y ^ z
+    if g == 1:
+        return (x & y) | (~x & z)
+    if g == 2:
+        return (x | ~y) ^ z
+    if g == 3:
+        return (x & z) | (y & ~z)
+    return x ^ (y | ~z)
+
+
+def py_compress(state: Tuple[int, ...], block: bytes) -> Tuple[int, ...]:
+    """Pure-Python RIPEMD-160 block compression on a 64-byte block."""
+    assert len(block) == BLOCK_BYTES
+    x = struct.unpack("<16I", block)
+    h0, h1, h2, h3, h4 = state
+    al, bl, cl, dl, el = state
+    ar, br, cr, dr, er = state
+    for j in range(80):
+        t = (al + _f(j, bl, cl, dl) + x[_RL[j]] + _KL[j // 16]) & _MASK
+        s = _SL[j]
+        t = (((t << s) | (t >> (32 - s))) + el) & _MASK
+        al, el, dl, cl, bl = el, dl, ((cl << 10) | (cl >> 22)) & _MASK, bl, t
+        t = (ar + _f(79 - j, br, cr, dr) + x[_RR[j]] + _KR[j // 16]) & _MASK
+        s = _SR[j]
+        t = (((t << s) | (t >> (32 - s))) + er) & _MASK
+        ar, er, dr, cr, br = er, dr, ((cr << 10) | (cr >> 22)) & _MASK, br, t
+    return (
+        (h1 + cl + dr) & _MASK,
+        (h2 + dl + er) & _MASK,
+        (h3 + el + ar) & _MASK,
+        (h4 + al + br) & _MASK,
+        (h0 + bl + cr) & _MASK,
+    )
+
+
+def py_absorb(prefix: bytes) -> Tuple[Tuple[int, ...], bytes, int]:
+    """Absorb all complete 64-byte blocks of ``prefix``; returns
+    ``(state, remainder_bytes, total_absorbed_len)`` (same contract as
+    md5_jax.py_absorb — the packing layer is model-agnostic)."""
+    state = RIPEMD160_INIT
+    n_full = len(prefix) // BLOCK_BYTES
+    for i in range(n_full):
+        state = py_compress(state, prefix[i * BLOCK_BYTES:(i + 1) * BLOCK_BYTES])
+    return state, prefix[n_full * BLOCK_BYTES:], n_full * BLOCK_BYTES
+
+
+def py_digest(message: bytes) -> bytes:
+    """Full RIPEMD-160 via the pure-Python compression (oracle)."""
+    state, rem, _ = py_absorb(message)
+    total = len(message)
+    tail = rem + b"\x80"
+    pad = (-len(tail) - 8) % BLOCK_BYTES
+    tail += b"\x00" * pad + struct.pack("<Q", total * 8)
+    for i in range(0, len(tail), BLOCK_BYTES):
+        state = py_compress(state, tail[i:i + BLOCK_BYTES])
+    return b"".join(w.to_bytes(4, "little") for w in state)
+
+
+class Ripemd160:
+    """Minimal hashlib-shaped shim over ``py_digest`` — the fallback
+    ``models/puzzle.py`` hands out when ``hashlib.new("ripemd160")``
+    raises (OpenSSL 3 without the legacy provider)."""
+
+    name = "ripemd160"
+    digest_size = 20
+    block_size = BLOCK_BYTES
+
+    def __init__(self, data: bytes = b""):
+        self._buf = bytearray(data)
+
+    def update(self, data: bytes) -> None:
+        self._buf += data
+
+    def digest(self) -> bytes:
+        return py_digest(bytes(self._buf))
+
+    def hexdigest(self) -> str:
+        return self.digest().hex()
+
+    def copy(self) -> "Ripemd160":
+        return Ripemd160(bytes(self._buf))

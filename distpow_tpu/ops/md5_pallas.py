@@ -1,5 +1,5 @@
 """Pallas TPU kernels for the fused proof-of-work search step
-(MD5, SHA-256, SHA-1 — every ``_TILE_FNS`` model).
+(MD5, SHA-256, SHA-1, RIPEMD-160 — every ``_TILE_FNS`` model).
 
 The hot op of the framework (SURVEY.md section 7 layer 4, the "north
 star"): one kernel launch evaluates a dense tile grid of candidates —
@@ -60,6 +60,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..models.md5_jax import MD5_K, MD5_S
 from ..models.registry import get_hash_model
+from ..models.ripemd160_py import _f as rmd_f
+from ..models.ripemd160_py import _KL as RMD_KL
+from ..models.ripemd160_py import _KR as RMD_KR
+from ..models.ripemd160_py import _RL as RMD_RL
+from ..models.ripemd160_py import _RR as RMD_RR
+from ..models.ripemd160_py import _SL as RMD_SL
+from ..models.ripemd160_py import _SR as RMD_SR
 from ..models.sha1_jax import SHA1_K
 from ..models.sha256_jax import SHA256_K
 from .difficulty import nibble_masks
@@ -82,7 +89,7 @@ LANES = 128
 # live-set shape: a 16-word schedule window + a short working chain),
 # NOT hardware-swept yet — sweep before trusting it for serving.
 MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (16, 1024),
-                  "sha1": (16, 1024)}
+                  "sha1": (16, 1024), "ripemd160": (16, 1024)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 
 
@@ -303,11 +310,78 @@ def _sha1_tile(words, init, mask_words: int = 5):
     return tuple(out)
 
 
+def _ripemd160_tile(words, init, mask_words: int = 5):
+    """DCE'd RIPEMD-160 compression on a tile (round 4, fourth model).
+
+    Two independent 80-round lines over the same 16 message words, each
+    in the SHA-1 functional single-chain form: with ``X[r]`` the value
+    written to ``b`` in round ``r`` of a line, the round inputs are
+
+        b = X[r-1],  c = X[r-2],  d = rotl(X[r-3], 10),
+        e = rotl(X[r-4], 10),  a = rotl(X[r-5], 10)
+
+    so one round is ``X[r] = rotl(a + f + (K[r//16] + w[R[r]]), S[r])
+    + e``.  Seam rule (unrolling rounds 0-4 against the register form):
+    chain indices <= -3 enter RAW (d0/e0/a0 are already in final
+    orientation), indices >= -2 rotate — identical in shape to the
+    SHA-1 tile's seam, with rotl 10 instead of 30.  The two lines are
+    explicit ILP: Mosaic can interleave them with no dependence, which
+    the single-chain MD5/SHA tiles cannot offer.
+
+    Final combine (spec): digest word j draws on late chain values of
+    BOTH lines (e.g. word 3 needs XR[79]), so mask-word DCE saves at
+    most one trailing round per line — computed per line from the live
+    words rather than assumed.  Returns 5 entries, ``None`` where dead.
+    """
+    mw = max(1, min(5, mask_words))
+    # per digest word j: (left chain index, right chain index) consumed
+    need = ((78, 77), (77, 76), (76, 75), (75, 79), (79, 78))
+    live = range(5 - mw, 5)
+    last_l = max(need[j][0] for j in live)
+    last_r = max(need[j][1] for j in live)
+
+    a0, b0, c0, d0, e0 = init
+
+    def line(K, R, S, reverse_f: bool, last: int):
+        X = {-1: b0, -2: c0, -3: d0, -4: e0, -5: a0}
+
+        def rot_in(idx):
+            return X[idx] if idx <= -3 else _rotl(X[idx], 10)
+
+        for r in range(last + 1):
+            b = X[r - 1]
+            c = X[r - 2]
+            d = rot_in(r - 3)
+            e = rot_in(r - 4)
+            a = rot_in(r - 5)
+            fj = rmd_f(79 - r if reverse_f else r, b, c, d)
+            X[r] = _rotl(a + fj + _round_key(K[r // 16], words[R[r]]),
+                         S[r]) + e
+        return X
+
+    XL = line(RMD_KL, RMD_RL, RMD_SL, False, last_l)
+    XR = line(RMD_KR, RMD_RR, RMD_SR, True, last_r)
+
+    # combine: final registers are bl=XL[79], cl=XL[78],
+    # dl=rotl(XL[77],10), el=rotl(XL[76],10), al=rotl(XL[75],10) (and
+    # the same pattern on the right); h' per the spec's cross-line sum
+    h0, h1, h2, h3, h4 = (jnp.uint32(s) for s in init)
+    combine = (
+        lambda: h1 + XL[78] + _rotl(XR[77], 10),
+        lambda: h2 + _rotl(XL[77], 10) + _rotl(XR[76], 10),
+        lambda: h3 + _rotl(XL[76], 10) + _rotl(XR[75], 10),
+        lambda: h4 + _rotl(XL[75], 10) + XR[79],
+        lambda: h0 + XL[79] + XR[78],
+    )
+    return tuple(combine[j]() if j >= 5 - mw else None for j in range(5))
+
+
 # model -> (tile fn, init-state words, digest words); a model has a
 # kernel iff it has an entry here, and MODEL_GEOMETRY above is checked
 # against this at import so the two can't drift apart.
 _TILE_FNS = {"md5": (_md5_tile, 4, 4), "sha256": (_sha256_tile, 8, 8),
-             "sha1": (_sha1_tile, 5, 5)}
+             "sha1": (_sha1_tile, 5, 5),
+             "ripemd160": (_ripemd160_tile, 5, 5)}
 assert set(_TILE_FNS) == set(MODEL_GEOMETRY), \
     "every pallas kernel model needs a MODEL_GEOMETRY entry and vice versa"
 

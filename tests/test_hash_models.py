@@ -8,8 +8,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distpow_tpu.models import md5_jax, sha1_jax, sha256_jax
-from distpow_tpu.models.registry import MD5, SHA1, SHA256, get_hash_model
+from distpow_tpu.models import md5_jax, ripemd160_jax, sha1_jax, sha256_jax
+from distpow_tpu.models.registry import (
+    MD5,
+    RIPEMD160,
+    SHA1,
+    SHA256,
+    get_hash_model,
+)
 
 
 def pad_md5(message: bytes) -> bytes:
@@ -80,15 +86,50 @@ def test_md5_jax_vectorized_batch():
         assert digest == hashlib.md5(m).digest()
 
 
-@pytest.mark.parametrize("model,href", [(MD5, hashlib.md5),
-                                        (SHA256, hashlib.sha256),
-                                        (SHA1, hashlib.sha1)])
+@pytest.mark.parametrize("model,href", [
+    (MD5, hashlib.md5),
+    (SHA256, hashlib.sha256),
+    (SHA1, hashlib.sha1),
+    (RIPEMD160, lambda m: hashlib.new("ripemd160", m)),
+])
 @pytest.mark.parametrize("length", [0, 5, 63, 64, 70, 128, 129])
 def test_py_twins_vs_hashlib(model, href, length):
     rng = random.Random(length * 31)
     msg = bytes(rng.randrange(256) for _ in range(length))
-    mod = {MD5: md5_jax, SHA256: sha256_jax, SHA1: sha1_jax}[model]
+    mod = {MD5: md5_jax, SHA256: sha256_jax, SHA1: sha1_jax,
+           RIPEMD160: ripemd160_jax}[model]
     assert mod.py_digest(msg) == href(msg).digest()
+
+
+@pytest.mark.parametrize("length", [0, 1, 8, 55, 56, 64, 65, 130])
+def test_ripemd160_jax_vs_hashlib(length):
+    rng = random.Random(3000 + length)
+    msg = bytes(rng.randrange(256) for _ in range(length))
+    # MD5's little-endian padding scheme (ISO 10118-3)
+    words = blocks_to_words(pad_md5(msg), "little")
+    state = RIPEMD160.init_state
+    for block in words:
+        state = ripemd160_jax.ripemd160_compress(
+            state, [jnp.uint32(w) for w in block])
+    digest = b"".join(int(w).to_bytes(4, "little") for w in state)
+    assert digest == hashlib.new("ripemd160", msg).digest()
+
+
+def test_ripemd160_spec_vectors():
+    """Published vectors from the RIPEMD-160 paper (Dobbertin,
+    Bosselaers, Preneel — Appendix B), independent of this machine's
+    hashlib/OpenSSL build."""
+    vectors = {
+        b"": "9c1185a5c5e9fc54612808977ee8f548b2258d31",
+        b"a": "0bdc9d2d256b3ee9daae347be6f4dc835a467ffe",
+        b"abc": "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc",
+        b"message digest": "5d0689ef49d2fae572b881b123a85ffa21595f36",
+        b"abcdefghijklmnopqrstuvwxyz":
+            "f71c27109c692c1b56bbdceb5b9d2865b3708dbc",
+        b"1234567890" * 8: "9b752e45573d4b39f4dbd3323cab82bf63326bfb",
+    }
+    for msg, want in vectors.items():
+        assert ripemd160_jax.py_digest(msg).hex() == want, msg
 
 
 def test_py_absorb_prefix_state():
@@ -113,8 +154,37 @@ def test_registry():
     assert get_hash_model("md5") is MD5
     assert get_hash_model("SHA256") is SHA256
     assert get_hash_model("sha1") is SHA1
+    assert get_hash_model("ripemd160") is RIPEMD160
     assert MD5.max_difficulty == 32
     assert SHA256.max_difficulty == 64
     assert SHA1.max_difficulty == 40
+    assert RIPEMD160.max_difficulty == 40
     with pytest.raises(ValueError):
         get_hash_model("sha1024")
+
+
+def test_ripemd160_fallback_without_openssl_support(monkeypatch):
+    """ripemd160 is the only registry model outside hashlib's guaranteed
+    set (stock OpenSSL 3 without the legacy provider refuses it); every
+    puzzle verification path must fall back to the spec-vector-pinned
+    pure-Python implementation (models/ripemd160_py.py) on such hosts."""
+    from distpow_tpu.models import puzzle
+
+    real_new = hashlib.new
+
+    def deny(name, *a, **k):
+        if name == "ripemd160":
+            raise ValueError("unsupported hash type ripemd160")
+        return real_new(name, *a, **k)
+
+    monkeypatch.setattr(hashlib, "new", deny)
+    h = puzzle.new_hash("ripemd160")
+    h.update(b"abc")
+    assert h.hexdigest() == "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+    assert RIPEMD160.hashlib_new().name == "ripemd160"
+    oracle = puzzle.python_search(b"\x0a\x0b", 2, list(range(256)),
+                                  algo="ripemd160")
+    assert puzzle.check_secret(b"\x0a\x0b", oracle, 2, algo="ripemd160")
+    # non-ripemd algos still reject unknown names
+    with pytest.raises(ValueError):
+        puzzle.new_hash("sha1024")

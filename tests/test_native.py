@@ -68,6 +68,28 @@ def test_native_sha1_vs_hashlib(length):
     assert native.native_sha1(data) == hashlib.sha1(data).digest()
 
 
+@pytest.mark.parametrize("length", [0, 1, 55, 64, 130])
+def test_native_ripemd160_vs_hashlib(length):
+    import random
+
+    rng = random.Random(3000 + length)
+    data = bytes(rng.randrange(256) for _ in range(length))
+    want = hashlib.new("ripemd160", data).digest()
+    assert native.native_ripemd160(data) == want
+
+
+def test_native_backend_ripemd160_matches_oracle():
+    """Ripemd160Traits through the same templated scan loop (round 4,
+    fourth model): reference enumeration order preserved."""
+    from distpow_tpu.models import puzzle
+
+    backend = native.NativeBackend("ripemd160", n_threads=1)
+    nonce = b"\x0a\x0b"
+    oracle = puzzle.python_search(nonce, 2, list(range(256)),
+                                  algo="ripemd160")
+    assert backend.search(nonce, 2, list(range(256))) == oracle
+
+
 def test_native_backend_sha1_matches_oracle():
     """Sha1Traits through the same templated scan loop: reference
     enumeration order for the third registry model too."""

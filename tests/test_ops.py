@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from distpow_tpu.models import puzzle
-from distpow_tpu.models.registry import MD5, SHA1, SHA256
+from distpow_tpu.models.registry import MD5, RIPEMD160, SHA1, SHA256
 from distpow_tpu.ops.difficulty import meets_difficulty, nibble_masks
 from distpow_tpu.ops.packing import build_tail_spec, make_words, pack_reference_bytes
 from distpow_tpu.ops.search_step import (
@@ -140,6 +140,7 @@ from distpow_tpu.ops.search_step import _dyn_search_step, cached_search_step
     MD5,
     pytest.param(SHA256, marks=pytest.mark.slow),
     pytest.param(SHA1, marks=pytest.mark.slow),
+    pytest.param(RIPEMD160, marks=pytest.mark.slow),
 ])
 @pytest.mark.parametrize("nonce_len,width", [(2, 1), (4, 2), (63, 1), (70, 2)])
 def test_dyn_step_matches_static(model, nonce_len, width):

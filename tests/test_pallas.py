@@ -98,6 +98,17 @@ def test_pallas_backend_serves_sha1_with_kernel():
                                           algo="sha1")
 
 
+def test_pallas_backend_serves_ripemd160_with_kernel():
+    # fourth model (round 4): the two-line tile serves through the
+    # kernel path in reference enumeration order
+    backend = PallasBackend(hash_model="ripemd160", batch_size=1 << 14,
+                            interpret=True)
+    nonce = b"\x33\x44"
+    secret = backend.search(nonce, 2, list(range(256)))
+    assert secret == puzzle.python_search(nonce, 2, list(range(256)),
+                                          algo="ripemd160")
+
+
 def test_pallas_backend_falls_back_for_model_without_kernel(monkeypatch):
     # a registry model WITHOUT a kernel entry -> transparent XLA
     # fallback (all three shipped models have kernels now, so the
@@ -284,6 +295,22 @@ def test_sha1_pallas_kernel_matches_xla_step():
         interpret=True
     )
     step_x = build_search_step(nonce, 1, 2, 0, 256, 8, SHA1)
+    for c0 in (1, 17):
+        assert int(step_p(jnp.uint32(c0))) == int(step_x(jnp.uint32(c0)))
+
+
+def test_ripemd160_pallas_kernel_matches_xla_step():
+    """Full ripemd160 kernel in interpret mode vs the XLA step.  Both
+    lines in the SHA-1-style functional form compile in seconds (no
+    sha256-style schedule expansion), so this is not a slow test."""
+    from distpow_tpu.models.registry import RIPEMD160
+
+    nonce = b"\x01\x02\x03\x04"
+    step_p = build_pallas_search_step(
+        nonce, 1, 2, 0, 256, 8, model_name="ripemd160", sublanes=8,
+        interpret=True
+    )
+    step_x = build_search_step(nonce, 1, 2, 0, 256, 8, RIPEMD160)
     for c0 in (1, 17):
         assert int(step_p(jnp.uint32(c0))) == int(step_x(jnp.uint32(c0)))
 

@@ -115,6 +115,22 @@ def test_search_sha1_model():
     assert got is not None and got.secret == oracle
 
 
+def test_search_ripemd160_model():
+    """Fourth registry model (round 4) end-to-end through the generic
+    driver, including the long-nonce host-absorption path."""
+    from distpow_tpu.models.registry import RIPEMD160
+
+    nonce = b"\x0a\x0b"
+    tbs = list(range(256))
+    oracle = puzzle.python_search(nonce, 2, tbs, algo="ripemd160")
+    got = search(nonce, 2, tbs, model=RIPEMD160, batch_size=1 << 13)
+    assert got is not None and got.secret == oracle
+    long_nonce = bytes(range(200))  # 3 blocks allows host absorption
+    oracle2 = puzzle.python_search(long_nonce, 2, tbs, algo="ripemd160")
+    got2 = search(long_nonce, 2, tbs, model=RIPEMD160, batch_size=1 << 13)
+    assert got2 is not None and got2.secret == oracle2
+
+
 def test_mesh_search_sha1_model():
     """sha1 through the shard_map mesh step (the stacked-window vma fix
     in sha1_jax._compress_loop is only exercised under shard_map)."""
