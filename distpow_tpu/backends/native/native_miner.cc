@@ -247,9 +247,99 @@ void CompressRipemd160(uint32_t state[5], const uint8_t block[64]) {
   state[0] = t;
 }
 
+
+// --- SHA-512 (FIPS 180-4) --------------------------------------------------
+// 128-byte blocks, 16-byte length field, 64-bit words.  The framework
+// carries SHA-512 state as 16 uint32 (hi, lo) pairs (models/sha512_py.py
+// convention); this CPU path reassembles native uint64 limbs internally.
+
+// init state as the framework's 16-uint32 (hi, lo) pairs, precomputed
+// at compile time (a lazily-built runtime array would need
+// synchronization under the multithreaded scan — review r4)
+constexpr uint32_t kSha512Init32[16] = {
+    0x6a09e667u, 0xf3bcc908u, 0xbb67ae85u, 0x84caa73bu,
+    0x3c6ef372u, 0xfe94f82bu, 0xa54ff53au, 0x5f1d36f1u,
+    0x510e527fu, 0xade682d1u, 0x9b05688cu, 0x2b3e6c1fu,
+    0x1f83d9abu, 0xfb41bd6bu, 0x5be0cd19u, 0x137e2179u};
+
+constexpr uint64_t kSha512K[80] = {
+    0x428a2f98d728ae22ull, 0x7137449123ef65cdull, 0xb5c0fbcfec4d3b2full,
+    0xe9b5dba58189dbbcull, 0x3956c25bf348b538ull, 0x59f111f1b605d019ull,
+    0x923f82a4af194f9bull, 0xab1c5ed5da6d8118ull, 0xd807aa98a3030242ull,
+    0x12835b0145706fbeull, 0x243185be4ee4b28cull, 0x550c7dc3d5ffb4e2ull,
+    0x72be5d74f27b896full, 0x80deb1fe3b1696b1ull, 0x9bdc06a725c71235ull,
+    0xc19bf174cf692694ull, 0xe49b69c19ef14ad2ull, 0xefbe4786384f25e3ull,
+    0x0fc19dc68b8cd5b5ull, 0x240ca1cc77ac9c65ull, 0x2de92c6f592b0275ull,
+    0x4a7484aa6ea6e483ull, 0x5cb0a9dcbd41fbd4ull, 0x76f988da831153b5ull,
+    0x983e5152ee66dfabull, 0xa831c66d2db43210ull, 0xb00327c898fb213full,
+    0xbf597fc7beef0ee4ull, 0xc6e00bf33da88fc2ull, 0xd5a79147930aa725ull,
+    0x06ca6351e003826full, 0x142929670a0e6e70ull, 0x27b70a8546d22ffcull,
+    0x2e1b21385c26c926ull, 0x4d2c6dfc5ac42aedull, 0x53380d139d95b3dfull,
+    0x650a73548baf63deull, 0x766a0abb3c77b2a8ull, 0x81c2c92e47edaee6ull,
+    0x92722c851482353bull, 0xa2bfe8a14cf10364ull, 0xa81a664bbc423001ull,
+    0xc24b8b70d0f89791ull, 0xc76c51a30654be30ull, 0xd192e819d6ef5218ull,
+    0xd69906245565a910ull, 0xf40e35855771202aull, 0x106aa07032bbd1b8ull,
+    0x19a4c116b8d2d0c8ull, 0x1e376c085141ab53ull, 0x2748774cdf8eeb99ull,
+    0x34b0bcb5e19b48a8ull, 0x391c0cb3c5c95a63ull, 0x4ed8aa4ae3418acbull,
+    0x5b9cca4f7763e373ull, 0x682e6ff3d6b2b8a3ull, 0x748f82ee5defb2fcull,
+    0x78a5636f43172f60ull, 0x84c87814a1f0ab72ull, 0x8cc702081a6439ecull,
+    0x90befffa23631e28ull, 0xa4506cebde82bde9ull, 0xbef9a3f7b2c67915ull,
+    0xc67178f2e372532bull, 0xca273eceea26619cull, 0xd186b8c721c0c207ull,
+    0xeada7dd6cde0eb1eull, 0xf57d4f7fee6ed178ull, 0x06f067aa72176fbaull,
+    0x0a637dc5a2c898a6ull, 0x113f9804bef90daeull, 0x1b710b35131c471bull,
+    0x28db77f523047d84ull, 0x32caab7b40c72493ull, 0x3c9ebe0a15c9bebcull,
+    0x431d67c49c100d4cull, 0x4cc5d4becb3e42b6ull, 0x597f299cfc657e2aull,
+    0x5fcb6fab3ad6faecull, 0x6c44198c4a475817ull};
+
+inline uint64_t Rotr64(uint64_t x, int s) {
+  return (x >> s) | (x << (64 - s));
+}
+
+void CompressSha512(uint32_t state32[16], const uint8_t block[128]) {
+  uint64_t w[80];
+  for (int i = 0; i < 16; ++i) {
+    uint64_t v = 0;
+    for (int j = 0; j < 8; ++j) {
+      v = (v << 8) | block[8 * i + j];
+    }
+    w[i] = v;
+  }
+  for (int i = 16; i < 80; ++i) {
+    const uint64_t s0 =
+        Rotr64(w[i - 15], 1) ^ Rotr64(w[i - 15], 8) ^ (w[i - 15] >> 7);
+    const uint64_t s1 =
+        Rotr64(w[i - 2], 19) ^ Rotr64(w[i - 2], 61) ^ (w[i - 2] >> 6);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint64_t hs[8];
+  for (int i = 0; i < 8; ++i) {
+    hs[i] = (static_cast<uint64_t>(state32[2 * i]) << 32) | state32[2 * i + 1];
+  }
+  uint64_t a = hs[0], b = hs[1], c = hs[2], d = hs[3];
+  uint64_t e = hs[4], f = hs[5], g = hs[6], h = hs[7];
+  for (int i = 0; i < 80; ++i) {
+    const uint64_t S1 = Rotr64(e, 14) ^ Rotr64(e, 18) ^ Rotr64(e, 41);
+    const uint64_t ch = (e & f) ^ (~e & g);
+    const uint64_t t1 = h + S1 + ch + kSha512K[i] + w[i];
+    const uint64_t S0 = Rotr64(a, 28) ^ Rotr64(a, 34) ^ Rotr64(a, 39);
+    const uint64_t maj = (a & b) ^ (a & c) ^ (b & c);
+    const uint64_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  const uint64_t out[8] = {hs[0] + a, hs[1] + b, hs[2] + c, hs[3] + d,
+                           hs[4] + e, hs[5] + f, hs[6] + g, hs[7] + h};
+  for (int i = 0; i < 8; ++i) {
+    state32[2 * i] = static_cast<uint32_t>(out[i] >> 32);
+    state32[2 * i + 1] = static_cast<uint32_t>(out[i]);
+  }
+}
+
 // --- hash traits bound into the templated scan loop ------------------------
 
 struct Md5Traits {
+  static constexpr int kBlockBytes = 64;
+  static constexpr int kLengthBytes = 8;
   static constexpr int kStateWords = 4;
   static constexpr int kDigestBytes = 16;
   static constexpr bool kBigEndianLength = false;
@@ -263,6 +353,8 @@ struct Md5Traits {
 };
 
 struct Sha256Traits {
+  static constexpr int kBlockBytes = 64;
+  static constexpr int kLengthBytes = 8;
   static constexpr int kStateWords = 8;
   static constexpr int kDigestBytes = 32;
   static constexpr bool kBigEndianLength = true;
@@ -281,6 +373,8 @@ struct Sha256Traits {
 };
 
 struct Sha1Traits {
+  static constexpr int kBlockBytes = 64;
+  static constexpr int kLengthBytes = 8;
   static constexpr int kStateWords = 5;
   static constexpr int kDigestBytes = 20;
   static constexpr bool kBigEndianLength = true;
@@ -299,6 +393,8 @@ struct Sha1Traits {
 };
 
 struct Ripemd160Traits {
+  static constexpr int kBlockBytes = 64;
+  static constexpr int kLengthBytes = 8;
   static constexpr int kStateWords = 5;
   static constexpr int kDigestBytes = 20;
   static constexpr bool kBigEndianLength = false;  // MD5-style padding
@@ -308,6 +404,26 @@ struct Ripemd160Traits {
   }
   static void StoreDigest(const uint32_t* state, uint8_t* out) {
     std::memcpy(out, state, 20);  // LE word serialization, like MD5
+  }
+};
+
+struct Sha512Traits {
+  static constexpr int kBlockBytes = 128;
+  static constexpr int kLengthBytes = 16;  // 128-bit bit-length field
+  static constexpr int kStateWords = 16;   // 8 x 64-bit as (hi, lo) pairs
+  static constexpr int kDigestBytes = 64;
+  static constexpr bool kBigEndianLength = true;
+  static const uint32_t* Init() { return kSha512Init32; }
+  static void Compress(uint32_t* state, const uint8_t* block) {
+    CompressSha512(state, block);
+  }
+  static void StoreDigest(const uint32_t* state, uint8_t* out) {
+    for (int i = 0; i < 16; ++i) {  // big-endian word serialization
+      out[4 * i] = static_cast<uint8_t>(state[i] >> 24);
+      out[4 * i + 1] = static_cast<uint8_t>(state[i] >> 16);
+      out[4 * i + 2] = static_cast<uint8_t>(state[i] >> 8);
+      out[4 * i + 3] = static_cast<uint8_t>(state[i]);
+    }
   }
 };
 
@@ -348,9 +464,12 @@ template <typename Traits>
 void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
                Found* found, uint64_t* hashes_out) {
   const size_t msg_len = t.nonce_len + 1 + t.width;
-  // Single-block fast path covers msg_len <= 55; longer prefixes use the
-  // generic multi-block path below.
-  uint8_t tail[128];
+  // Tail spans at most two blocks: rem < kBlockBytes and the secret +
+  // padding + length field add < kBlockBytes more (width <= 8,
+  // kLengthBytes <= 16).
+  constexpr size_t kBB = Traits::kBlockBytes;
+  constexpr size_t kLB = Traits::kLengthBytes;
+  uint8_t tail[2 * kBB];
   uint64_t hashes = 0;
   const uint64_t poll = 4096;
   uint64_t next_poll = poll;
@@ -358,23 +477,27 @@ void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
   // Precompute the constant prefix state for long messages.
   uint32_t prefix_state[Traits::kStateWords];
   std::memcpy(prefix_state, Traits::Init(), sizeof(prefix_state));
-  size_t absorbed = (t.nonce_len / 64) * 64;
-  for (size_t off = 0; off < absorbed; off += 64) {
+  size_t absorbed = (t.nonce_len / kBB) * kBB;
+  for (size_t off = 0; off < absorbed; off += kBB) {
     Traits::Compress(prefix_state, t.nonce + off);
   }
   const uint8_t* rem = t.nonce + absorbed;
   const size_t rem_len = t.nonce_len - absorbed;
   const size_t tail_content = rem_len + 1 + t.width;
-  const size_t tail_blocks = (tail_content + 1 + 8 + 63) / 64;
-  const size_t tail_len = tail_blocks * 64;
+  const size_t tail_blocks = (tail_content + 1 + kLB + kBB - 1) / kBB;
+  const size_t tail_len = tail_blocks * kBB;
 
   std::memset(tail, 0, sizeof(tail));
   std::memcpy(tail, rem, rem_len);
   tail[tail_content] = 0x80;
+  // the bit length is a uint64; a 16-byte field's high bytes stay zero
+  // (shifts >= 64 would be UB, hence the guard)
   const uint64_t bitlen = static_cast<uint64_t>(msg_len) * 8;
-  for (int i = 0; i < 8; ++i) {
-    const int shift = Traits::kBigEndianLength ? 8 * (7 - i) : 8 * i;
-    tail[tail_len - 8 + i] = static_cast<uint8_t>(bitlen >> shift);
+  for (size_t i = 0; i < kLB; ++i) {
+    const size_t shift = Traits::kBigEndianLength
+                             ? 8 * (kLB - 1 - i) : 8 * i;
+    tail[tail_len - kLB + i] =
+        shift < 64 ? static_cast<uint8_t>(bitlen >> shift) : 0;
   }
 
   for (uint64_t chunk = chunk_lo; chunk < chunk_hi; ++chunk) {
@@ -395,7 +518,7 @@ void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
       uint32_t state[Traits::kStateWords];
       std::memcpy(state, prefix_state, sizeof(state));
       for (size_t b = 0; b < tail_blocks; ++b) {
-        Traits::Compress(state, tail + 64 * b);
+        Traits::Compress(state, tail + kBB * b);
       }
       ++hashes;
       uint8_t digest[Traits::kDigestBytes];
@@ -444,23 +567,27 @@ int SearchRange(const SearchTask& task, uint64_t chunk_count,
 // Full digest of an arbitrary buffer (self-test hooks below).
 template <typename Traits>
 void DigestBuffer(const uint8_t* data, size_t len, uint8_t* out) {
+  constexpr size_t kBB = Traits::kBlockBytes;
+  constexpr size_t kLB = Traits::kLengthBytes;
   uint32_t state[Traits::kStateWords];
   std::memcpy(state, Traits::Init(), sizeof(state));
-  size_t full = (len / 64) * 64;
-  for (size_t off = 0; off < full; off += 64)
+  size_t full = (len / kBB) * kBB;
+  for (size_t off = 0; off < full; off += kBB)
     Traits::Compress(state, data + off);
-  uint8_t tail[128];
+  uint8_t tail[2 * kBB];
   std::memset(tail, 0, sizeof(tail));
   size_t rem = len - full;
   std::memcpy(tail, data + full, rem);
   tail[rem] = 0x80;
-  size_t tail_len = rem + 9 <= 64 ? 64 : 128;
+  size_t tail_len = rem + 1 + kLB <= kBB ? kBB : 2 * kBB;
   uint64_t bits = static_cast<uint64_t>(len) * 8;
-  for (int i = 0; i < 8; ++i) {
-    const int shift = Traits::kBigEndianLength ? 8 * (7 - i) : 8 * i;
-    tail[tail_len - 8 + i] = static_cast<uint8_t>(bits >> shift);
+  for (size_t i = 0; i < kLB; ++i) {
+    const size_t shift = Traits::kBigEndianLength
+                             ? 8 * (kLB - 1 - i) : 8 * i;
+    tail[tail_len - kLB + i] =
+        shift < 64 ? static_cast<uint8_t>(bits >> shift) : 0;
   }
-  for (size_t b = 0; b < tail_len; b += 64) Traits::Compress(state, tail + b);
+  for (size_t b = 0; b < tail_len; b += kBB) Traits::Compress(state, tail + b);
   Traits::StoreDigest(state, out);
 }
 
@@ -482,7 +609,7 @@ extern "C" {
 // acceptable per the puzzle contract, coordinator.go:202).
 //
 // `algo`: 0 = MD5 (reference parity), 1 = SHA-256 (the north-star hash
-// option), 2 = SHA-1, 3 = RIPEMD-160; -2 on any other value.
+// option), 2 = SHA-1, 3 = RIPEMD-160, 4 = SHA-512; -2 on any other value.
 int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint32_t difficulty, uint32_t algo,
                          const uint8_t* thread_bytes,
@@ -490,7 +617,7 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint64_t chunk_count, int32_t n_threads,
                          const volatile int32_t* cancel_flag,
                          uint64_t* out_hashes, uint8_t* out_secret) {
-  if (n_tb == 0 || width > 8 || algo > 3) return -2;
+  if (n_tb == 0 || width > 8 || algo > 4) return -2;
   // a difficulty beyond the digest's nibble count would read past the
   // digest buffer in MeetsDifficulty (and the puzzle is unsatisfiable
   // anyway — the JAX paths reject it in nibble_masks)
@@ -498,7 +625,8 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
       2 * (algo == 0   ? Md5Traits::kDigestBytes
            : algo == 1 ? Sha256Traits::kDigestBytes
            : algo == 2 ? Sha1Traits::kDigestBytes
-                       : Ripemd160Traits::kDigestBytes);
+           : algo == 3 ? Ripemd160Traits::kDigestBytes
+                       : Sha512Traits::kDigestBytes);
   if (difficulty > max_nibbles) return -2;
   SearchTask task{nonce,        nonce_len,  difficulty,
                   thread_bytes, n_tb,       width,
@@ -512,9 +640,11 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
     SearchRange<Sha256Traits>(task, chunk_count, n_threads, &found, &hashes);
   } else if (algo == 2) {
     SearchRange<Sha1Traits>(task, chunk_count, n_threads, &found, &hashes);
-  } else {
+  } else if (algo == 3) {
     SearchRange<Ripemd160Traits>(task, chunk_count, n_threads, &found,
                                  &hashes);
+  } else {
+    SearchRange<Sha512Traits>(task, chunk_count, n_threads, &found, &hashes);
   }
 
   if (out_hashes) *out_hashes = hashes;
@@ -546,6 +676,10 @@ void distpow_sha1(const uint8_t* data, size_t len, uint8_t out[20]) {
 
 void distpow_ripemd160(const uint8_t* data, size_t len, uint8_t out[20]) {
   DigestBuffer<Ripemd160Traits>(data, len, out);
+}
+
+void distpow_sha512(const uint8_t* data, size_t len, uint8_t out[64]) {
+  DigestBuffer<Sha512Traits>(data, len, out);
 }
 
 }  // extern "C"

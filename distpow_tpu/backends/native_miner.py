@@ -57,7 +57,8 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_search_range.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,          # nonce
             ctypes.c_uint32,                            # difficulty
-            ctypes.c_uint32,        # algo (0 md5, 1 sha256, 2 sha1, 3 ripemd160)
+            ctypes.c_uint32,   # algo: 0 md5, 1 sha256, 2 sha1,
+                               # 3 ripemd160, 4 sha512
             ctypes.c_char_p, ctypes.c_size_t,          # thread bytes
             ctypes.c_uint32,                            # width
             ctypes.c_uint64, ctypes.c_uint64,          # chunk start/count
@@ -82,18 +83,24 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_ripemd160.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.distpow_sha512.restype = None
+        lib.distpow_sha512.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
         _lib = lib
         return lib
 
 
-ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2, "ripemd160": 3}
+ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2, "ripemd160": 3,
+            "sha512": 4}
 
 # Digest sizes (bytes) for the native algorithms, fixed by RFC 1321 /
 # FIPS 180-4.  max difficulty = hex nibbles = 2 * digest bytes; kept
 # local (mirroring the C library's own rc=-2 guard) so the native hot
 # path never imports the JAX model modules (advisor r3: resolving
 # max_difficulty via models.registry pulled jax into native-only use).
-DIGEST_BYTES = {"md5": 16, "sha256": 32, "sha1": 20, "ripemd160": 20}
+DIGEST_BYTES = {"md5": 16, "sha256": 32, "sha1": 20, "ripemd160": 20,
+                "sha512": 64}
 
 
 def native_md5(data: bytes) -> bytes:
@@ -121,6 +128,13 @@ def native_ripemd160(data: bytes) -> bytes:
     lib = load_library()
     out = ctypes.create_string_buffer(20)
     lib.distpow_ripemd160(data, len(data), out)
+    return out.raw
+
+
+def native_sha512(data: bytes) -> bytes:
+    lib = load_library()
+    out = ctypes.create_string_buffer(64)
+    lib.distpow_sha512(data, len(data), out)
     return out.raw
 
 

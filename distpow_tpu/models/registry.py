@@ -13,7 +13,7 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Sequence, Tuple
 
-from . import md5_jax, ripemd160_jax, sha1_jax, sha256_jax
+from . import md5_jax, ripemd160_jax, sha1_jax, sha256_jax, sha512_jax
 
 
 @dataclass(frozen=True)
@@ -27,10 +27,17 @@ class HashModel:
     compress: Callable         # (state, words[16]) -> state, vectorized JAX
     py_compress: Callable      # pure-Python twin, for host-side absorption
     py_absorb: Callable        # prefix -> (state, remainder, absorbed_len)
+    # Size of the message-bit-length field in the padding (8 for every
+    # 64-byte-block MD hash; 16 for SHA-384/512's 128-bit field).
+    length_bytes: int = 8
 
     @property
     def digest_bytes(self) -> int:
         return self.digest_words * 4
+
+    @property
+    def words_per_block(self) -> int:
+        return self.block_bytes // 4
 
     @property
     def max_difficulty(self) -> int:
@@ -94,8 +101,22 @@ RIPEMD160 = HashModel(
     py_absorb=ripemd160_jax.py_absorb,
 )
 
+SHA512 = HashModel(
+    name="sha512",
+    block_bytes=sha512_jax.BLOCK_BYTES,
+    digest_words=sha512_jax.DIGEST_WORDS,
+    word_byteorder=sha512_jax.WORD_BYTEORDER,
+    length_byteorder=sha512_jax.LENGTH_BYTEORDER,
+    init_state=sha512_jax.SHA512_INIT,
+    compress=sha512_jax.sha512_compress,
+    py_compress=sha512_jax.py_compress,
+    py_absorb=sha512_jax.py_absorb,
+    length_bytes=sha512_jax.LENGTH_BYTES,
+)
+
 _REGISTRY: Dict[str, HashModel] = {
     "md5": MD5, "sha256": SHA256, "sha1": SHA1, "ripemd160": RIPEMD160,
+    "sha512": SHA512,
 }
 
 

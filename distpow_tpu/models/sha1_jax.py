@@ -87,7 +87,12 @@ def _compress_loop(state, words):
     into a replicated slot — a carry-type mismatch the stack avoids by
     unifying the axis-varying type at construction."""
     ws = [_u32(m) for m in words]
-    shape = jnp.broadcast_shapes(*(jnp.shape(w) for w in ws))
+    # include the STATE shapes: a tail block can be all-constant (the
+    # padding/length block of a 2-block tail whose variable bytes all
+    # landed in block 0) while the incoming state is batch-shaped —
+    # words alone would give shape () and broadcast_to would throw
+    shape = jnp.broadcast_shapes(*(jnp.shape(w) for w in ws),
+                                 *(jnp.shape(_u32(s)) for s in state))
     st = tuple(_u32(s) for s in state)
     for i in range(16):
         st = _round(st, i, ws[i])

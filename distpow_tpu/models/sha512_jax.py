@@ -1,0 +1,228 @@
+"""SHA-512 as a vectorized JAX computation over uint32 (hi, lo) pairs.
+
+Fifth registry model (round 4) and the interface-generality proof: the
+first model with 128-byte blocks, a 16-byte length field, and 64-bit
+words.  A TPU VPU has no native uint64 lanes, so every 64-bit value is
+carried as a (hi32, lo32) pair of uint32 lanes and the FIPS 180-4
+operations are emulated limb-wise:
+
+* ``add64``: lo-limb add, carry = (sum < either addend) via an unsigned
+  compare, hi-limb add + carry — 4 VPU ops per 64-bit add.
+* ``rotr64 by n``: two shifts + OR per limb, crossing limbs; n == 32 is
+  a free limb swap, n > 32 swaps then rotates by n - 32.  XLA folds the
+  constant shift amounts, so a rotation costs 6 ops (vs 3 for a 32-bit
+  rotation).
+* bitwise ops apply per limb at no overhead.
+
+Everything else — packing (16 uint32 template words per *half* block
+row, ``model.words_per_block`` = 32), trailing-nibble difficulty masks
+over 16 uint32 digest words, the search drivers, warmup, backends —
+consumes the standard uint32-word interface unchanged; only this module
+knows the words pair up.  The pure-Python twin and spec constants live
+in the jax-free ``sha512_py`` (same split as ripemd160).
+
+The 80-round graph is fully unrolled like the other accelerator forms;
+the live set (8 x 2 working limbs + a 16 x 2-limb schedule window) is
+the largest of the shipped models — if XLA's register allocation caps
+throughput the way sha256's did at ~77%, a Pallas tile with an explicit
+geometry is the known fix (docs/KERNELS.md), but parity correctness
+comes first: there is no kernel tile yet and the pallas backends fall
+back to this fused step transparently.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .sha512_py import (  # noqa: F401  (shared spec data + py twin)
+    BLOCK_BYTES,
+    DIGEST_WORDS,
+    LENGTH_BYTEORDER,
+    LENGTH_BYTES,
+    SHA512_INIT,
+    SHA512_INIT64,
+    SHA512_K64,
+    WORD_BYTEORDER,
+    py_absorb,
+    py_compress,
+    py_digest,
+)
+
+U32 = jnp.uint32
+Pair = Tuple  # (hi, lo) of broadcast-compatible uint32 values
+
+
+def _u(x):
+    return x if hasattr(x, "dtype") else jnp.uint32(int(x) & 0xFFFFFFFF)
+
+
+def _add64(a: Pair, b: Pair) -> Pair:
+    """(hi, lo) + (hi, lo) with carry via an unsigned compare."""
+    ah, al = a
+    bh, bl = b
+    al, bl = _u(al), _u(bl)
+    lo = al + bl
+    carry = (lo < al).astype(U32) if hasattr(lo, "dtype") else U32(lo < al)
+    return _u(ah) + _u(bh) + carry, lo
+
+
+def _add64_many(*vals: Pair) -> Pair:
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = _add64(acc, v)
+    return acc
+
+
+def _rotr64(x: Pair, n: int) -> Pair:
+    hi, lo = _u(x[0]), _u(x[1])
+    if n == 0:
+        return hi, lo
+    if n == 32:
+        return lo, hi
+    if n > 32:
+        hi, lo, n = lo, hi, n - 32
+    return (
+        (hi >> n) | (lo << (32 - n)),
+        (lo >> n) | (hi << (32 - n)),
+    )
+
+
+def _shr64(x: Pair, n: int) -> Pair:
+    hi, lo = _u(x[0]), _u(x[1])
+    assert 0 < n < 32  # the only shifts SHA-512 needs (7 and 6)
+    return hi >> n, (lo >> n) | (hi << (32 - n))
+
+
+def _xor64(*vals: Pair) -> Pair:
+    hi, lo = _u(vals[0][0]), _u(vals[0][1])
+    for v in vals[1:]:
+        hi = hi ^ _u(v[0])
+        lo = lo ^ _u(v[1])
+    return hi, lo
+
+
+def _sigma0(w: Pair) -> Pair:
+    return _xor64(_rotr64(w, 1), _rotr64(w, 8), _shr64(w, 7))
+
+
+def _sigma1(w: Pair) -> Pair:
+    return _xor64(_rotr64(w, 19), _rotr64(w, 61), _shr64(w, 6))
+
+
+def _round64(st, k: Pair, w: Pair):
+    """One SHA-512 round on a tuple of 8 (hi, lo) pairs."""
+    a, b, c, d, e, f, g, h = st
+    S1 = _xor64(_rotr64(e, 14), _rotr64(e, 18), _rotr64(e, 41))
+    ch = ((e[0] & f[0]) ^ (~e[0] & g[0]),
+          (e[1] & f[1]) ^ (~e[1] & g[1]))
+    t1 = _add64_many(h, S1, ch, k, w)
+    S0 = _xor64(_rotr64(a, 28), _rotr64(a, 34), _rotr64(a, 39))
+    maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+           (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
+    return (_add64(t1, _add64(S0, maj)), a, b, c, _add64(d, t1), e, f, g)
+
+
+def _k_pair(i: int) -> Pair:
+    k = SHA512_K64[i]
+    return U32((k >> 32) & 0xFFFFFFFF), U32(k & 0xFFFFFFFF)
+
+
+def _compress_unrolled(state, words):
+    """Fully unrolled 80-round form (accelerators): schedule pairs fed
+    only by constant words stay scalars XLA folds, and the whole graph
+    fuses register-to-register — same rationale as sha256/sha1."""
+    w = [(_u(words[2 * i]), _u(words[2 * i + 1])) for i in range(16)]
+    for i in range(16, 80):
+        w.append(_add64_many(w[i - 16], _sigma0(w[i - 15]), w[i - 7],
+                             _sigma1(w[i - 2])))
+    hs = [(_u(state[2 * i]), _u(state[2 * i + 1])) for i in range(8)]
+    st = tuple(hs)
+    for i in range(80):
+        st = _round64(st, _k_pair(i), w[i])
+    out = []
+    for hv, nv in zip(hs, st):
+        rh, rl = _add64(hv, nv)
+        out.extend((rh, rl))
+    return tuple(out)
+
+
+def _compress_loop(state, words):
+    """fori_loop form (XLA:CPU): rounds 0-15 unrolled on the raw word
+    pairs, rounds 16-79 carry a rolling window.  The unrolled 80-round
+    emulation graph (~2x sha256's width in uint32 ops) hits the same
+    XLA:CPU codegen blowup sha256 did — observed >9 min with no result;
+    this form compiles in seconds.
+
+    The window is ONE stacked (32, *batch) uint32 array — rows 2i/2i+1
+    are word i's (hi, lo) limbs — not a tuple, for the same shard_map
+    carry-type reason as sha1_jax._compress_loop (rotating a tuple
+    moves an axis-varying value into a replicated slot)."""
+    ws = [_u(m) for m in words]
+    # include the STATE shapes — same all-constant-block case as
+    # sha256_jax._compress_loop (see comment there)
+    shape = jnp.broadcast_shapes(*(jnp.shape(w) for w in ws),
+                                 *(jnp.shape(_u(s)) for s in state))
+    st = tuple(
+        (_u(state[2 * i]), _u(state[2 * i + 1])) for i in range(8)
+    )
+    hs0 = st
+    for i in range(16):
+        st = _round64(st, _k_pair(i), (ws[2 * i], ws[2 * i + 1]))
+
+    window = jnp.stack([jnp.broadcast_to(w, shape) for w in ws])
+    vzero = window[0] & jnp.uint32(0)
+    st = tuple(
+        (jnp.broadcast_to(p[0], shape) + vzero,
+         jnp.broadcast_to(p[1], shape) + vzero)
+        for p in st
+    )
+    # round constants as (80,) hi/lo arrays, built per trace (a module-
+    # level jnp array would leak a tracer on first in-jit construction)
+    k_hi = jnp.asarray(np.array([k >> 32 for k in SHA512_K64], np.uint32))
+    k_lo = jnp.asarray(
+        np.array([k & 0xFFFFFFFF for k in SHA512_K64], np.uint32))
+
+    def body(i, carry):
+        st, win = carry
+        w15 = (win[2], win[3])
+        w7 = (win[18], win[19])
+        w2 = (win[28], win[29])
+        w16 = (win[0], win[1])
+        nh, nl = _add64_many(w16, _sigma0(w15), w7, _sigma1(w2))
+        st = _round64(st, (k_hi[i], k_lo[i]), (nh, nl))
+        return st, jnp.concatenate([win[2:], nh[None], nl[None]], axis=0)
+
+    st, _ = lax.fori_loop(16, 80, body, (st, window), unroll=2)
+    out = []
+    for hv, nv in zip(hs0, st):
+        rh, rl = _add64(hv, nv)
+        out.extend((rh, rl))
+    return tuple(out)
+
+
+@jax.jit
+def _sha512_compress_jit(state, words):
+    # platform-keyed like sha256/sha1: loop on XLA:CPU, unrolled elsewhere
+    if jax.default_backend() == "cpu":
+        return _compress_loop(state, words)
+    return _compress_unrolled(state, words)
+
+
+def sha512_compress(state, words: Sequence):
+    """One SHA-512 block compression, vectorized.
+
+    ``state`` is 16 uint32 entries ((hi, lo) per 64-bit word); ``words``
+    is 32 broadcast-compatible uint32 entries — the 16 message words of
+    one 128-byte block as (hi, lo) pairs in order, exactly how the
+    packing template serializes big-endian 64-bit words into uint32s.
+    Eager calls route through a module-level jit (compile once per shape
+    signature); under an outer jit the nested jit is inlined.
+    """
+    return _sha512_compress_jit(
+        tuple(_u(s) for s in state), tuple(_u(w) for w in words)
+    )

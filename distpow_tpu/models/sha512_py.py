@@ -1,0 +1,131 @@
+"""SHA-512 constants and pure-Python implementation (jax-free).
+
+Companion of ``sha512_jax`` (round 4, fifth registry model) in the same
+split as ``ripemd160_py``/``ripemd160_jax``: spec data + the int twin
+live here, importable without jax.  Constants from FIPS 180-4.
+
+SHA-512 is the interface-generality proof for the model layer: 128-byte
+blocks, a 16-byte bit-length field, and 64-bit words — the framework
+carries 64-bit state as (hi32, lo32) uint32 pairs end to end (16 uint32
+state words, big-endian serialization), because the packing/difficulty/
+search layers speak uint32 lanes (a TPU has no native uint64 VPU type).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+BLOCK_BYTES = 128
+DIGEST_WORDS = 16          # 8 x 64-bit = 16 uint32 (hi, lo) pairs
+WORD_BYTEORDER = "big"
+LENGTH_BYTEORDER = "big"
+LENGTH_BYTES = 16          # 128-bit message bit-length field
+
+# FIPS 180-4 section 5.3.5: initial hash value (64-bit words).
+SHA512_INIT64 = (
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B,
+    0xA54FF53A5F1D36F1, 0x510E527FADE682D1, 0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+)
+
+# Section 4.2.3: eighty 64-bit round constants.
+SHA512_K64 = (
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F,
+    0xE9B5DBA58189DBBC, 0x3956C25BF348B538, 0x59F111F1B605D019,
+    0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118, 0xD807AA98A3030242,
+    0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235,
+    0xC19BF174CF692694, 0xE49B69C19EF14AD2, 0xEFBE4786384F25E3,
+    0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65, 0x2DE92C6F592B0275,
+    0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F,
+    0xBF597FC7BEEF0EE4, 0xC6E00BF33DA88FC2, 0xD5A79147930AA725,
+    0x06CA6351E003826F, 0x142929670A0E6E70, 0x27B70A8546D22FFC,
+    0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6,
+    0x92722C851482353B, 0xA2BFE8A14CF10364, 0xA81A664BBC423001,
+    0xC24B8B70D0F89791, 0xC76C51A30654BE30, 0xD192E819D6EF5218,
+    0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99,
+    0x34B0BCB5E19B48A8, 0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB,
+    0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3, 0x748F82EE5DEFB2FC,
+    0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915,
+    0xC67178F2E372532B, 0xCA273ECEEA26619C, 0xD186B8C721C0C207,
+    0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178, 0x06F067AA72176FBA,
+    0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC,
+    0x431D67C49C100D4C, 0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A,
+    0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+)
+
+# The framework-facing init: 16 uint32 words, (hi, lo) per 64-bit word.
+SHA512_INIT = tuple(
+    w for v in SHA512_INIT64 for w in ((v >> 32) & 0xFFFFFFFF, v & 0xFFFFFFFF)
+)
+
+_M64 = (1 << 64) - 1
+
+
+def _rotr64(x: int, n: int) -> int:
+    return ((x >> n) | (x << (64 - n))) & _M64
+
+
+def py_compress(state: Tuple[int, ...], block: bytes) -> Tuple[int, ...]:
+    """Pure-Python SHA-512 block compression.
+
+    ``state`` is the framework's 16-uint32 (hi, lo) representation; the
+    arithmetic runs on reassembled 64-bit ints and splits back at the
+    end, so this twin also documents the pairing convention the JAX
+    compress emulates limb-wise.
+    """
+    assert len(block) == BLOCK_BYTES
+    w = list(struct.unpack(">16Q", block))
+    for i in range(16, 80):
+        s0 = _rotr64(w[i - 15], 1) ^ _rotr64(w[i - 15], 8) ^ (w[i - 15] >> 7)
+        s1 = _rotr64(w[i - 2], 19) ^ _rotr64(w[i - 2], 61) ^ (w[i - 2] >> 6)
+        w.append((w[i - 16] + s0 + w[i - 7] + s1) & _M64)
+    hs = [
+        (state[2 * i] << 32) | state[2 * i + 1] for i in range(8)
+    ]
+    a, b, c, d, e, f, g, h = hs
+    for i in range(80):
+        S1 = _rotr64(e, 14) ^ _rotr64(e, 18) ^ _rotr64(e, 41)
+        ch = (e & f) ^ (~e & g)
+        t1 = (h + S1 + ch + SHA512_K64[i] + w[i]) & _M64
+        S0 = _rotr64(a, 28) ^ _rotr64(a, 34) ^ _rotr64(a, 39)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (S0 + maj) & _M64
+        h, g, f, e = g, f, e, (d + t1) & _M64
+        d, c, b, a = c, b, a, (t1 + t2) & _M64
+    out64 = [
+        (hv + nv) & _M64
+        for hv, nv in zip(hs, (a, b, c, d, e, f, g, h))
+    ]
+    return tuple(
+        w for v in out64 for w in ((v >> 32) & 0xFFFFFFFF, v & 0xFFFFFFFF)
+    )
+
+
+def py_absorb(prefix: bytes) -> Tuple[Tuple[int, ...], bytes, int]:
+    """Absorb all complete 128-byte blocks of ``prefix``; same contract
+    as the other models' ``py_absorb`` (the packing layer reads
+    ``model.block_bytes``, so the different block size is transparent)."""
+    state = SHA512_INIT
+    n_full = len(prefix) // BLOCK_BYTES
+    for i in range(n_full):
+        state = py_compress(state, prefix[i * BLOCK_BYTES:(i + 1) * BLOCK_BYTES])
+    return state, prefix[n_full * BLOCK_BYTES:], n_full * BLOCK_BYTES
+
+
+def py_digest(message: bytes) -> bytes:
+    """Full SHA-512 via the pure-Python compression (oracle)."""
+    state, rem, _ = py_absorb(message)
+    total = len(message)
+    tail = rem + b"\x80"
+    pad = (-len(tail) - LENGTH_BYTES) % BLOCK_BYTES
+    tail += b"\x00" * pad + (total * 8).to_bytes(LENGTH_BYTES, "big")
+    for i in range(0, len(tail), BLOCK_BYTES):
+        state = py_compress(state, tail[i:i + BLOCK_BYTES])
+    return b"".join(w.to_bytes(4, "big") for w in state)

@@ -82,7 +82,8 @@ def build_tail_spec(
 
     # Tail layout: rem ‖ [tb] ‖ [chunk×width] ‖ extra ‖ 0x80 ‖ 0… ‖ len64
     content = len(rem) + 1 + width + len(extra_const_chunk)
-    n_blocks = (content + 1 + 8 + model.block_bytes - 1) // model.block_bytes
+    n_blocks = (content + 1 + model.length_bytes + model.block_bytes - 1) \
+        // model.block_bytes
     tail = bytearray(n_blocks * model.block_bytes)
     tail[: len(rem)] = rem
     # tb and chunk bytes stay zero in the template; recorded as locations.
@@ -91,7 +92,11 @@ def build_tail_spec(
     extra_pos = chunk_pos0 + width
     tail[extra_pos : extra_pos + len(extra_const_chunk)] = extra_const_chunk
     tail[extra_pos + len(extra_const_chunk)] = 0x80
-    tail[-8:] = (msg_len * 8).to_bytes(8, model.length_byteorder)
+    # the bit-length field: 8 bytes for 64-byte-block hashes, 16 for
+    # SHA-384/512 (whose 2^128 length space no real message exercises —
+    # the high half is always zero here, as in every practical impl)
+    tail[-model.length_bytes:] = (msg_len * 8).to_bytes(
+        model.length_bytes, model.length_byteorder)
 
     fmt_order = model.word_byteorder
     base_words: List[Tuple[int, ...]] = []
@@ -100,7 +105,7 @@ def build_tail_spec(
         base_words.append(
             tuple(
                 int.from_bytes(blk[4 * w : 4 * w + 4], fmt_order)
-                for w in range(16)
+                for w in range(model.words_per_block)
             )
         )
 

@@ -123,7 +123,7 @@ def eval_dyn_candidates(model, n_blocks, tb_loc, chunk_locs, init, base, tb, chu
     """
     state = tuple(init[i] for i in range(len(model.init_state)))
     for b in range(n_blocks):
-        words = [base[b, w] for w in range(16)]
+        words = [base[b, w] for w in range(model.words_per_block)]
         bb, w, s = tb_loc
         if bb == b:
             words[w] = words[w] | (tb << s)
@@ -176,7 +176,7 @@ def _dyn_search_step(
     """Layout-keyed jitted step with nonce/difficulty/partition as operands.
 
     Signature of the returned jitted fn (all uint32):
-    ``(init_state[S], base_words[n_blocks,16], masks[D], tb_lo,
+    ``(init_state[S], base_words[n_blocks,W], masks[D], tb_lo,
     log_tbc_or_nothing, chunk0) -> uint32``.
 
     ``launch_steps`` sub-batches of ``batch`` candidates run inside one

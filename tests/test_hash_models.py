@@ -8,12 +8,19 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distpow_tpu.models import md5_jax, ripemd160_jax, sha1_jax, sha256_jax
+from distpow_tpu.models import (
+    md5_jax,
+    ripemd160_jax,
+    sha1_jax,
+    sha256_jax,
+    sha512_jax,
+)
 from distpow_tpu.models.registry import (
     MD5,
     RIPEMD160,
     SHA1,
     SHA256,
+    SHA512,
     get_hash_model,
 )
 
@@ -91,13 +98,14 @@ def test_md5_jax_vectorized_batch():
     (SHA256, hashlib.sha256),
     (SHA1, hashlib.sha1),
     (RIPEMD160, lambda m: hashlib.new("ripemd160", m)),
+    (SHA512, hashlib.sha512),
 ])
 @pytest.mark.parametrize("length", [0, 5, 63, 64, 70, 128, 129])
 def test_py_twins_vs_hashlib(model, href, length):
     rng = random.Random(length * 31)
     msg = bytes(rng.randrange(256) for _ in range(length))
     mod = {MD5: md5_jax, SHA256: sha256_jax, SHA1: sha1_jax,
-           RIPEMD160: ripemd160_jax}[model]
+           RIPEMD160: ripemd160_jax, SHA512: sha512_jax}[model]
     assert mod.py_digest(msg) == href(msg).digest()
 
 
@@ -155,6 +163,9 @@ def test_registry():
     assert get_hash_model("SHA256") is SHA256
     assert get_hash_model("sha1") is SHA1
     assert get_hash_model("ripemd160") is RIPEMD160
+    assert get_hash_model("sha512") is SHA512
+    assert SHA512.max_difficulty == 128
+    assert SHA512.words_per_block == 32 and SHA512.length_bytes == 16
     assert MD5.max_difficulty == 32
     assert SHA256.max_difficulty == 64
     assert SHA1.max_difficulty == 40
@@ -188,3 +199,48 @@ def test_ripemd160_fallback_without_openssl_support(monkeypatch):
     # non-ripemd algos still reject unknown names
     with pytest.raises(ValueError):
         puzzle.new_hash("sha1024")
+
+
+@pytest.mark.parametrize("length", [0, 1, 8, 111, 112, 127, 128, 129, 260])
+def test_sha512_jax_vs_hashlib(length):
+    """Fifth model (round 4): 128-byte blocks, 16-byte length field,
+    64-bit words emulated as (hi, lo) uint32 pairs.  Lengths straddle
+    the 112-mod-128 two-block-padding boundary and the 128-byte block
+    boundary."""
+    rng = random.Random(4000 + length)
+    msg = bytes(rng.randrange(256) for _ in range(length))
+    tail = msg + b"\x80"
+    tail += b"\x00" * ((-len(tail) - 16) % 128)
+    tail += (len(msg) * 8).to_bytes(16, "big")
+    state = SHA512.init_state
+    for i in range(0, len(tail), 128):
+        words = struct.unpack(">32I", tail[i:i + 128])
+        state = sha512_jax.sha512_compress(state, [jnp.uint32(w) for w in words])
+    digest = b"".join(int(w).to_bytes(4, "big") for w in state)
+    assert digest == hashlib.sha512(msg).digest()
+
+
+def test_sha512_spec_vector():
+    """FIPS 180-4 / NIST example vector, independent of hashlib."""
+    assert sha512_jax.py_digest(b"abc").hex() == (
+        "ddaf35a193617abacc417349ae20413112e6fa4e89a97ea20a9eeee64b55d39a"
+        "2192992a274fc1a836ba3c23a3feebbd454d4423643ce80e2a9ac94fa54ca49f")
+
+
+def test_loop_compress_all_constant_block_with_batched_state():
+    """Regression (round 4): a tail block can be ALL-constant (every
+    message word a scalar) while the incoming state is batch-shaped —
+    the fori_loop forms derived their broadcast shape from the words
+    alone and crashed in broadcast_to.  Exercises sha256, sha1, and
+    sha512 loop forms directly."""
+    for model in (SHA256, SHA1, SHA512):
+        n = model.words_per_block
+        batch_state = tuple(
+            jnp.full((7,), s, jnp.uint32) for s in model.init_state)
+        out = model.compress(batch_state, [int(i + 1) for i in range(n)])
+        # must equal the scalar-state result broadcast
+        ref = model.compress(model.init_state,
+                             [int(i + 1) for i in range(n)])
+        for o, r in zip(out, ref):
+            assert o.shape == (7,)
+            assert int(o[3]) == int(r)

@@ -90,6 +90,29 @@ def test_native_backend_ripemd160_matches_oracle():
     assert backend.search(nonce, 2, list(range(256))) == oracle
 
 
+@pytest.mark.parametrize("length", [0, 1, 111, 112, 128, 260])
+def test_native_sha512_vs_hashlib(length):
+    import random
+
+    rng = random.Random(4000 + length)
+    data = bytes(rng.randrange(256) for _ in range(length))
+    assert native.native_sha512(data) == hashlib.sha512(data).digest()
+
+
+def test_native_backend_sha512_matches_oracle():
+    """Sha512Traits: the first 128-byte-block / 16-byte-length trait
+    through the generalized scan loop (round 4)."""
+    from distpow_tpu.models import puzzle
+
+    backend = native.NativeBackend("sha512", n_threads=1)
+    nonce = b"\x0a\x0b"
+    oracle = puzzle.python_search(nonce, 2, list(range(256)), algo="sha512")
+    assert backend.search(nonce, 2, list(range(256))) == oracle
+    long_nonce = bytes(range(140))  # host-absorbs one full 128B block
+    o2 = puzzle.python_search(long_nonce, 1, list(range(256)), algo="sha512")
+    assert backend.search(long_nonce, 1, list(range(256))) == o2
+
+
 def test_native_backend_sha1_matches_oracle():
     """Sha1Traits through the same templated scan loop: reference
     enumeration order for the third registry model too."""

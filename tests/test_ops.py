@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from distpow_tpu.models import puzzle
-from distpow_tpu.models.registry import MD5, RIPEMD160, SHA1, SHA256
+from distpow_tpu.models.registry import MD5, RIPEMD160, SHA1, SHA256, SHA512
 from distpow_tpu.ops.difficulty import meets_difficulty, nibble_masks
 from distpow_tpu.ops.packing import build_tail_spec, make_words, pack_reference_bytes
 from distpow_tpu.ops.search_step import (
@@ -26,7 +26,12 @@ def digest_of(spec, model, tb, chunk):
     return b"".join(int(w).to_bytes(4, model.word_byteorder) for w in state)
 
 
-@pytest.mark.parametrize("model", [MD5, SHA256, SHA1])
+@pytest.mark.parametrize("model", [
+    MD5, SHA256, SHA1,
+    # 36 eager loop-form compiles; the fast path keeps sha512 packing
+    # covered via test_sha512_jax_vs_hashlib + the search-layer tests
+    pytest.param(SHA512, marks=pytest.mark.slow),
+])
 @pytest.mark.parametrize("nonce_len", [0, 4, 20, 54, 55, 63, 64, 65, 130])
 @pytest.mark.parametrize("width", [0, 1, 3, 4])
 def test_packing_matches_hashlib(model, nonce_len, width):
@@ -52,7 +57,7 @@ def test_packing_extra_const_chunk():
     assert len(msg) == 4 + 1 + 4 + 2
 
 
-@pytest.mark.parametrize("model", [MD5, SHA256, SHA1])
+@pytest.mark.parametrize("model", [MD5, SHA256, SHA1, SHA512])
 def test_nibble_masks_vs_oracle(model):
     rng = random.Random(42)
     for _ in range(300):
@@ -141,6 +146,7 @@ from distpow_tpu.ops.search_step import _dyn_search_step, cached_search_step
     pytest.param(SHA256, marks=pytest.mark.slow),
     pytest.param(SHA1, marks=pytest.mark.slow),
     pytest.param(RIPEMD160, marks=pytest.mark.slow),
+    pytest.param(SHA512, marks=pytest.mark.slow),
 ])
 @pytest.mark.parametrize("nonce_len,width", [(2, 1), (4, 2), (63, 1), (70, 2)])
 def test_dyn_step_matches_static(model, nonce_len, width):

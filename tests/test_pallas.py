@@ -109,6 +109,17 @@ def test_pallas_backend_serves_ripemd160_with_kernel():
                                           algo="ripemd160")
 
 
+def test_pallas_backend_falls_back_for_sha512():
+    # sha512 is a REAL no-kernel model (no _TILE_FNS entry): the pallas
+    # backend must serve it through the transparent XLA fallback
+    backend = PallasBackend(hash_model="sha512", batch_size=1 << 13,
+                            interpret=True)
+    nonce = b"\x55\x66"
+    secret = backend.search(nonce, 2, list(range(256)))
+    assert secret == puzzle.python_search(nonce, 2, list(range(256)),
+                                          algo="sha512")
+
+
 def test_pallas_backend_falls_back_for_model_without_kernel(monkeypatch):
     # a registry model WITHOUT a kernel entry -> transparent XLA
     # fallback (all three shipped models have kernels now, so the

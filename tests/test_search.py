@@ -131,6 +131,39 @@ def test_search_ripemd160_model():
     assert got2 is not None and got2.secret == oracle2
 
 
+def test_search_sha512_model():
+    """Fifth registry model (round 4): 128-byte blocks and a 16-byte
+    length field through the generic driver — the interface-generality
+    case — including the two-block-tail padding boundary and long-nonce
+    host absorption of a full 128-byte block."""
+    from distpow_tpu.models.registry import SHA512
+
+    tbs = list(range(256))
+    oracle = puzzle.python_search(b"\x0a\x0b", 2, tbs, algo="sha512")
+    got = search(b"\x0a\x0b", 2, tbs, model=SHA512, batch_size=1 << 13)
+    assert got is not None and got.secret == oracle
+    for L in (111, 112, 140):
+        nonce = bytes(range(L))
+        o = puzzle.python_search(nonce, 1, tbs, algo="sha512")
+        g = search(nonce, 1, tbs, model=SHA512, batch_size=1 << 12)
+        assert g is not None and g.secret == o, L
+
+
+def test_search_all_constant_tail_block():
+    """Regression (round 4): nonce lengths where the secret fits block 0
+    entirely but padding forces a second, ALL-constant tail block (rem +
+    1 + width in [56, 63] for 64-byte-block hashes) crashed the sha
+    fori_loop forms on CPU."""
+    from distpow_tpu.models.registry import SHA1, SHA256
+
+    tbs = list(range(256))
+    for model, algo in ((SHA256, "sha256"), (SHA1, "sha1")):
+        nonce = bytes(range(59))  # 59 + 1 + 4 = 64 <= 64 < 64 + 9
+        o = puzzle.python_search(nonce, 1, tbs, algo=algo)
+        g = search(nonce, 1, tbs, model=model, batch_size=1 << 12)
+        assert g is not None and g.secret == o, algo
+
+
 def test_mesh_search_sha1_model():
     """sha1 through the shard_map mesh step (the stacked-window vma fix
     in sha1_jax._compress_loop is only exercised under shard_map)."""
