@@ -427,6 +427,34 @@ struct Sha512Traits {
   }
 };
 
+// SHA-384 = SHA-512's compression with its own init, digest truncated
+// to the first six 64-bit words (FIPS 180-4 section 5.3.4, round 4).
+constexpr uint32_t kSha384Init32[16] = {
+    0xcbbb9d5du, 0xc1059ed8u, 0x629a292au, 0x367cd507u,
+    0x9159015au, 0x3070dd17u, 0x152fecd8u, 0xf70e5939u,
+    0x67332667u, 0xffc00b31u, 0x8eb44a87u, 0x68581511u,
+    0xdb0c2e0du, 0x64f98fa7u, 0x47b5481du, 0xbefa4fa4u};
+
+struct Sha384Traits {
+  static constexpr int kBlockBytes = 128;
+  static constexpr int kLengthBytes = 16;
+  static constexpr int kStateWords = 16;  // full sha512 state carried
+  static constexpr int kDigestBytes = 48;  // truncated serialization
+  static constexpr bool kBigEndianLength = true;
+  static const uint32_t* Init() { return kSha384Init32; }
+  static void Compress(uint32_t* state, const uint8_t* block) {
+    CompressSha512(state, block);
+  }
+  static void StoreDigest(const uint32_t* state, uint8_t* out) {
+    for (int i = 0; i < 12; ++i) {  // first 12 of 16 state words
+      out[4 * i] = static_cast<uint8_t>(state[i] >> 24);
+      out[4 * i + 1] = static_cast<uint8_t>(state[i] >> 16);
+      out[4 * i + 2] = static_cast<uint8_t>(state[i] >> 8);
+      out[4 * i + 3] = static_cast<uint8_t>(state[i]);
+    }
+  }
+};
+
 // Trailing zero nibbles of the digest, scanned from the end: low nibble
 // of the last byte first (hex-string order).
 inline bool MeetsDifficulty(const uint8_t* digest, int digest_bytes,
@@ -609,7 +637,8 @@ extern "C" {
 // acceptable per the puzzle contract, coordinator.go:202).
 //
 // `algo`: 0 = MD5 (reference parity), 1 = SHA-256 (the north-star hash
-// option), 2 = SHA-1, 3 = RIPEMD-160, 4 = SHA-512; -2 on any other value.
+// option), 2 = SHA-1, 3 = RIPEMD-160, 4 = SHA-512, 5 = SHA-384;
+// -2 on any other value.
 int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint32_t difficulty, uint32_t algo,
                          const uint8_t* thread_bytes,
@@ -617,7 +646,7 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint64_t chunk_count, int32_t n_threads,
                          const volatile int32_t* cancel_flag,
                          uint64_t* out_hashes, uint8_t* out_secret) {
-  if (n_tb == 0 || width > 8 || algo > 4) return -2;
+  if (n_tb == 0 || width > 8 || algo > 5) return -2;
   // a difficulty beyond the digest's nibble count would read past the
   // digest buffer in MeetsDifficulty (and the puzzle is unsatisfiable
   // anyway — the JAX paths reject it in nibble_masks)
@@ -626,7 +655,8 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
            : algo == 1 ? Sha256Traits::kDigestBytes
            : algo == 2 ? Sha1Traits::kDigestBytes
            : algo == 3 ? Ripemd160Traits::kDigestBytes
-                       : Sha512Traits::kDigestBytes);
+           : algo == 4 ? Sha512Traits::kDigestBytes
+                       : Sha384Traits::kDigestBytes);
   if (difficulty > max_nibbles) return -2;
   SearchTask task{nonce,        nonce_len,  difficulty,
                   thread_bytes, n_tb,       width,
@@ -643,8 +673,10 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
   } else if (algo == 3) {
     SearchRange<Ripemd160Traits>(task, chunk_count, n_threads, &found,
                                  &hashes);
-  } else {
+  } else if (algo == 4) {
     SearchRange<Sha512Traits>(task, chunk_count, n_threads, &found, &hashes);
+  } else {
+    SearchRange<Sha384Traits>(task, chunk_count, n_threads, &found, &hashes);
   }
 
   if (out_hashes) *out_hashes = hashes;
@@ -680,6 +712,10 @@ void distpow_ripemd160(const uint8_t* data, size_t len, uint8_t out[20]) {
 
 void distpow_sha512(const uint8_t* data, size_t len, uint8_t out[64]) {
   DigestBuffer<Sha512Traits>(data, len, out);
+}
+
+void distpow_sha384(const uint8_t* data, size_t len, uint8_t out[48]) {
+  DigestBuffer<Sha384Traits>(data, len, out);
 }
 
 }  // extern "C"

@@ -13,7 +13,14 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Sequence, Tuple
 
-from . import md5_jax, ripemd160_jax, sha1_jax, sha256_jax, sha512_jax
+from . import (
+    md5_jax,
+    ripemd160_jax,
+    sha1_jax,
+    sha256_jax,
+    sha384_jax,
+    sha512_jax,
+)
 
 
 @dataclass(frozen=True)
@@ -50,7 +57,12 @@ class HashModel:
         return puzzle.new_hash(self.name)  # ripemd160 fallback included
 
     def state_to_digest(self, state: Sequence[int]) -> bytes:
-        return b"".join(int(w) .to_bytes(4, self.word_byteorder) for w in state)
+        # truncating models (sha384) carry more state words than digest
+        # words; the digest is always the leading digest_words
+        return b"".join(
+            int(w).to_bytes(4, self.word_byteorder)
+            for w in state[: self.digest_words]
+        )
 
 
 MD5 = HashModel(
@@ -114,9 +126,22 @@ SHA512 = HashModel(
     length_bytes=sha512_jax.LENGTH_BYTES,
 )
 
+SHA384 = HashModel(
+    name="sha384",
+    block_bytes=sha384_jax.BLOCK_BYTES,
+    digest_words=sha384_jax.DIGEST_WORDS,  # 12 < 16 state words (truncated)
+    word_byteorder=sha384_jax.WORD_BYTEORDER,
+    length_byteorder=sha384_jax.LENGTH_BYTEORDER,
+    init_state=sha384_jax.SHA384_INIT,
+    compress=sha384_jax.sha384_compress,
+    py_compress=sha384_jax.py_compress,
+    py_absorb=sha384_jax.py_absorb,
+    length_bytes=sha384_jax.LENGTH_BYTES,
+)
+
 _REGISTRY: Dict[str, HashModel] = {
     "md5": MD5, "sha256": SHA256, "sha1": SHA1, "ripemd160": RIPEMD160,
-    "sha512": SHA512,
+    "sha512": SHA512, "sha384": SHA384,
 }
 
 

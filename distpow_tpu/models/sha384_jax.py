@@ -1,0 +1,54 @@
+"""SHA-384 — SHA-512's truncated sibling (round 4, sixth registry model).
+
+FIPS 180-4 section 5.3.4: identical compression and padding to SHA-512
+with a different initial hash value, and the digest is the first six
+64-bit words (48 bytes) of the final state.  Everything is shared with
+``sha512_jax``/``sha512_py``; this module only contributes the init
+constants and the truncation, which exercises a new interface case:
+``digest_words`` (12) SMALLER than the state width (16).  The
+difficulty-mask layer reads only digest words (``state[:digest_words]``
+carry the digest; the mask fold slices the trailing ones), and
+verification goes through hashlib, so truncation is free — but it is
+the first model where ``len(init_state) != digest_words``, pinned by
+tests so no layer silently assumes they match.
+"""
+
+from __future__ import annotations
+
+from .sha512_jax import sha512_compress as sha384_compress  # noqa: F401
+from .sha512_py import BLOCK_BYTES  # noqa: F401
+from .sha512_py import LENGTH_BYTEORDER  # noqa: F401
+from .sha512_py import LENGTH_BYTES  # noqa: F401
+from .sha512_py import WORD_BYTEORDER  # noqa: F401
+from .sha512_py import py_compress as _sha512_py_compress
+
+DIGEST_WORDS = 12  # 6 x 64-bit = 48 bytes; state stays 16 uint32 words
+
+# FIPS 180-4 section 5.3.4 initial hash value.
+SHA384_INIT64 = (
+    0xCBBB9D5DC1059ED8, 0x629A292A367CD507, 0x9159015A3070DD17,
+    0x152FECD8F70E5939, 0x67332667FFC00B31, 0x8EB44A8768581511,
+    0xDB0C2E0D64F98FA7, 0x47B5481DBEFA4FA4,
+)
+SHA384_INIT = tuple(
+    w for v in SHA384_INIT64 for w in ((v >> 32) & 0xFFFFFFFF, v & 0xFFFFFFFF)
+)
+
+
+def py_compress(state, block):
+    return _sha512_py_compress(state, block)
+
+
+def py_absorb(prefix: bytes):
+    from . import sha512_py
+
+    return sha512_py.py_absorb(prefix, init=SHA384_INIT)
+
+
+def py_digest(message: bytes) -> bytes:
+    # one copy of the padding rules (sha512_py), parameterized by init
+    # and the truncated digest width (review r4)
+    from . import sha512_py
+
+    return sha512_py.py_digest(message, init=SHA384_INIT,
+                               digest_words=DIGEST_WORDS)

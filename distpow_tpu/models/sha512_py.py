@@ -108,24 +108,27 @@ def py_compress(state: Tuple[int, ...], block: bytes) -> Tuple[int, ...]:
     )
 
 
-def py_absorb(prefix: bytes) -> Tuple[Tuple[int, ...], bytes, int]:
+def py_absorb(prefix: bytes, init=SHA512_INIT) -> Tuple[Tuple[int, ...], bytes, int]:
     """Absorb all complete 128-byte blocks of ``prefix``; same contract
     as the other models' ``py_absorb`` (the packing layer reads
-    ``model.block_bytes``, so the different block size is transparent)."""
-    state = SHA512_INIT
+    ``model.block_bytes``, so the different block size is transparent).
+    ``init`` parameterizes the variant (sha384_jax passes its own)."""
+    state = init
     n_full = len(prefix) // BLOCK_BYTES
     for i in range(n_full):
         state = py_compress(state, prefix[i * BLOCK_BYTES:(i + 1) * BLOCK_BYTES])
     return state, prefix[n_full * BLOCK_BYTES:], n_full * BLOCK_BYTES
 
 
-def py_digest(message: bytes) -> bytes:
-    """Full SHA-512 via the pure-Python compression (oracle)."""
-    state, rem, _ = py_absorb(message)
+def py_digest(message: bytes, init=SHA512_INIT, digest_words: int = 16) -> bytes:
+    """Full SHA-512-family digest via the pure-Python compression
+    (oracle): one copy of the padding rules for sha512 AND sha384
+    (review r4 — the truncating sibling passes its init and 12)."""
+    state, rem, _ = py_absorb(message, init)
     total = len(message)
     tail = rem + b"\x80"
     pad = (-len(tail) - LENGTH_BYTES) % BLOCK_BYTES
     tail += b"\x00" * pad + (total * 8).to_bytes(LENGTH_BYTES, "big")
     for i in range(0, len(tail), BLOCK_BYTES):
         state = py_compress(state, tail[i:i + BLOCK_BYTES])
-    return b"".join(w.to_bytes(4, "big") for w in state)
+    return b"".join(w.to_bytes(4, "big") for w in state[:digest_words])

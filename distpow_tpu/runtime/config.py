@@ -11,8 +11,8 @@ load unchanged.  TPU-specific extensions are additive with defaults:
   (hashlib loop, the CPU-parity baseline), ``native`` (C++ miner).
 * ``WorkerConfig.HashModel`` — any registry model
   (models/registry.py): ``md5`` (reference parity, default),
-  ``sha256`` (north-star variant), ``sha1``, ``ripemd160``, or
-  ``sha512``.
+  ``sha256`` (north-star variant), ``sha1``, ``ripemd160``,
+  ``sha512``, or ``sha384``.
 * ``WorkerConfig.BatchSize`` — candidates per device launch.
 
 Unknown JSON fields are ignored (forward compatibility); missing fields

@@ -13,6 +13,7 @@ from distpow_tpu.models import (
     ripemd160_jax,
     sha1_jax,
     sha256_jax,
+    sha384_jax,
     sha512_jax,
 )
 from distpow_tpu.models.registry import (
@@ -20,6 +21,7 @@ from distpow_tpu.models.registry import (
     RIPEMD160,
     SHA1,
     SHA256,
+    SHA384,
     SHA512,
     get_hash_model,
 )
@@ -99,13 +101,15 @@ def test_md5_jax_vectorized_batch():
     (SHA1, hashlib.sha1),
     (RIPEMD160, lambda m: hashlib.new("ripemd160", m)),
     (SHA512, hashlib.sha512),
+    (SHA384, hashlib.sha384),
 ])
 @pytest.mark.parametrize("length", [0, 5, 63, 64, 70, 128, 129])
 def test_py_twins_vs_hashlib(model, href, length):
     rng = random.Random(length * 31)
     msg = bytes(rng.randrange(256) for _ in range(length))
     mod = {MD5: md5_jax, SHA256: sha256_jax, SHA1: sha1_jax,
-           RIPEMD160: ripemd160_jax, SHA512: sha512_jax}[model]
+           RIPEMD160: ripemd160_jax, SHA512: sha512_jax,
+           SHA384: sha384_jax}[model]
     assert mod.py_digest(msg) == href(msg).digest()
 
 
@@ -166,6 +170,12 @@ def test_registry():
     assert get_hash_model("sha512") is SHA512
     assert SHA512.max_difficulty == 128
     assert SHA512.words_per_block == 32 and SHA512.length_bytes == 16
+    assert get_hash_model("sha384") is SHA384
+    # the truncating model: digest narrower than the carried state
+    assert SHA384.max_difficulty == 96 and SHA384.digest_words == 12
+    assert len(SHA384.init_state) == 16
+    assert SHA384.state_to_digest(SHA384.init_state) == b"".join(
+        w.to_bytes(4, "big") for w in SHA384.init_state[:12])
     assert MD5.max_difficulty == 32
     assert SHA256.max_difficulty == 64
     assert SHA1.max_difficulty == 40
@@ -244,3 +254,21 @@ def test_loop_compress_all_constant_block_with_batched_state():
         for o, r in zip(out, ref):
             assert o.shape == (7,)
             assert int(o[3]) == int(r)
+
+
+def test_sha384_spec_vector_and_truncation():
+    """FIPS 180-4 vector; the digest is the first 48 bytes of the
+    (differently-initialized) sha512 state — the truncating-model case
+    (digest_words < state words) no layer may conflate."""
+    assert sha384_jax.py_digest(b"abc").hex() == (
+        "cb00753f45a35e8bb5a03d699ac65007272c32ab0eded1631a8b605a43ff5bed"
+        "8086072ba1e7cc2358baeca134c825a7")
+    # mining parity at a difficulty whose masks live in the truncated
+    # digest's trailing words
+    from distpow_tpu.models import puzzle
+    from distpow_tpu.parallel.search import search
+
+    tbs = list(range(256))
+    oracle = puzzle.python_search(b"\x31\x41", 2, tbs, algo="sha384")
+    got = search(b"\x31\x41", 2, tbs, model=SHA384, batch_size=1 << 13)
+    assert got is not None and got.secret == oracle

@@ -113,6 +113,27 @@ def test_native_backend_sha512_matches_oracle():
     assert backend.search(long_nonce, 1, list(range(256))) == o2
 
 
+@pytest.mark.parametrize("length", [0, 111, 112, 260])
+def test_native_sha384_vs_hashlib(length):
+    import random
+
+    rng = random.Random(5000 + length)
+    data = bytes(rng.randrange(256) for _ in range(length))
+    assert native.native_sha384(data) == hashlib.sha384(data).digest()
+
+
+def test_native_backend_sha384_matches_oracle():
+    """Sha384Traits: truncated digest through the generic scan loop —
+    MeetsDifficulty must read the 48-byte digest, not the 64-byte
+    state."""
+    from distpow_tpu.models import puzzle
+
+    backend = native.NativeBackend("sha384", n_threads=1)
+    oracle = puzzle.python_search(b"\x31\x41", 2, list(range(256)),
+                                  algo="sha384")
+    assert backend.search(b"\x31\x41", 2, list(range(256))) == oracle
+
+
 def test_native_backend_sha1_matches_oracle():
     """Sha1Traits through the same templated scan loop: reference
     enumeration order for the third registry model too."""
