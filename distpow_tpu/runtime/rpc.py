@@ -130,12 +130,23 @@ class RPCServer:
         try:
             while True:
                 req = _read_frame(conn)
+                if not isinstance(req, dict):
+                    # valid JSON, wrong shape (e.g. a bare number):
+                    # drop the connection rather than crash the
+                    # dispatch thread on req.get (adversarial-input
+                    # hardening, round 4)
+                    raise RPCError(f"non-object frame: {type(req).__name__}")
                 threading.Thread(
                     target=self._dispatch,
                     args=(conn, wlock, req),
                     daemon=True,
                 ).start()
-        except (ConnectionError, OSError, json.JSONDecodeError):
+        except (ConnectionError, OSError, ValueError, RPCError):
+            # ValueError covers json.JSONDecodeError AND the
+            # UnicodeDecodeError a non-UTF-8 payload raises; RPCError
+            # covers protocol violations from _read_frame (oversized
+            # frame) and the shape check above — close the offending
+            # connection quietly; other clients are unaffected
             pass
         finally:
             with self._lock:
@@ -251,6 +262,8 @@ class RPCClient:
         try:
             while True:
                 resp = _read_frame(self._sock)
+                if not isinstance(resp, dict):
+                    raise RPCError(f"non-object frame: {type(resp).__name__}")
                 with self._plock:
                     fut = self._pending.pop(resp.get("id"), None)
                 if fut is None:
@@ -259,7 +272,10 @@ class RPCClient:
                     fut.set_exception(RPCError(resp["error"]))
                 else:
                     fut.set_result(resp.get("result"))
-        except (ConnectionError, OSError, json.JSONDecodeError) as exc:
+        except (ConnectionError, OSError, ValueError, RPCError) as exc:
+            # same coverage as the server reader (review r4): an
+            # oversized/undecodable/non-object response must FAIL the
+            # pending futures, not strand them behind a dead reader
             with self._plock:
                 pending, self._pending = self._pending, {}
             err = exc if self._closed is False else ConnectionError("client closed")

@@ -404,3 +404,60 @@ def test_tracing_server_end_to_end(tmp_path):
     time.sleep(0.3)
     assert "intruder" not in out.read_text()
     server.close()
+
+
+def test_rpc_server_survives_adversarial_frames():
+    """Protocol robustness (round 4): garbage bytes, an oversized
+    length prefix, valid-JSON-wrong-shape frames, and truncated frames
+    must each cost only the offending CONNECTION — the server keeps
+    serving well-formed clients afterward, with no wedged threads."""
+    import socket
+    import struct
+
+    class Echo:
+        def Ping(self, params):
+            return {"pong": params.get("n")}
+
+    srv = RPCServer()
+    srv.register("Echo", Echo())
+    addr = srv.listen("127.0.0.1:0")
+    srv.serve_in_background()
+    host, _, port = addr.rpartition(":")
+
+    def raw_conn():
+        return socket.create_connection((host, int(port)), timeout=5)
+
+    try:
+        # (a) garbage bytes where the length prefix should be
+        s = raw_conn()
+        s.sendall(b"\xde\xad\xbe\xef" + b"\x00" * 64)
+        s.close()
+        # (b) oversized frame announcement (would be a 1 GiB read)
+        s = raw_conn()
+        s.sendall(struct.pack(">I", 1 << 30))
+        s.close()
+        # (c) valid JSON, wrong shape (a bare number)
+        s = raw_conn()
+        payload = b"5"
+        s.sendall(struct.pack(">I", len(payload)) + payload)
+        # server must drop this connection, not crash a thread
+        assert s.recv(1) == b""  # orderly close from the server side
+        s.close()
+        # (c2) valid length, invalid UTF-8 payload (UnicodeDecodeError
+        # is a ValueError, NOT a json.JSONDecodeError — review r4)
+        s = raw_conn()
+        s.sendall(struct.pack(">I", 1) + b"\xff")
+        assert s.recv(1) == b""
+        s.close()
+        # (d) truncated frame then hard disconnect
+        s = raw_conn()
+        s.sendall(struct.pack(">I", 100) + b"partial")
+        s.close()
+        # (e) a well-formed client still gets served
+        cli = RPCClient(addr)
+        try:
+            assert cli.call("Echo.Ping", {"n": 7}) == {"pong": 7}
+        finally:
+            cli.close()
+    finally:
+        srv.shutdown()

@@ -180,6 +180,26 @@ def test_mesh_search_sha1_model():
     assert got is not None and got.secret == oracle
 
 
+@pytest.mark.slow
+def test_mesh_search_new_models():
+    """ripemd160 and sha512 through the shard_map mesh step (round 4):
+    the stacked-window sha512 loop form must carry cleanly under
+    shard_map's varying-axis types, and the two-line ripemd compression
+    must shard like any other."""
+    import jax
+
+    from distpow_tpu.models.registry import RIPEMD160, SHA512
+    from distpow_tpu.parallel.mesh_search import make_mesh, search_mesh
+
+    mesh = make_mesh(jax.devices())
+    tbs = list(range(256))
+    for model, algo in ((RIPEMD160, "ripemd160"), (SHA512, "sha512")):
+        oracle = puzzle.python_search(b"\x0a\x0b", 2, tbs, algo=algo)
+        got = search_mesh(b"\x0a\x0b", 2, tbs, model=model, mesh=mesh,
+                          batch_size=1 << 13)
+        assert got is not None and got.secret == oracle, algo
+
+
 def test_search_long_nonce_multi_block():
     # nonce longer than one hash block: constant blocks absorb host-side
     nonce = bytes(range(256))[:100]
