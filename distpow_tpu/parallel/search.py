@@ -253,9 +253,23 @@ def search(
                 WATCHDOG.beat()  # factory may compile (bounded, legit gap)
                 k = launch_steps_for(vw, target_chunks, tbc, launch_candidates)
                 step, chunks_per_step = factory(vw, extra, target_chunks, k)
-                n_cand = chunks_per_step * tbc
                 chunk0 = lo
                 while chunk0 < hi:
+                    # A launch's compiled span can overshoot the
+                    # segment end (the chunk count is a compile-time
+                    # shape; the tail launch is not re-compiled
+                    # smaller).  Overshot chunk ints alias back into
+                    # already-covered candidates via the width mask —
+                    # harmless for first-hit order (an aliased hit
+                    # implies an equal in-launch or already-scanned
+                    # hit) — but they are NOT searched work: count only
+                    # the in-segment candidates, or hashes_tried /
+                    # search.hashes inflate by orders of magnitude on
+                    # small partitions and max_hashes budgets misfire
+                    # (found by the round-4 differential fuzz: a
+                    # [240,241] partition reported 16.7M hashes for a
+                    # 4.8k-candidate solve).
+                    n_cand = min(chunks_per_step, hi - chunk0) * tbc
                     WATCHDOG.beat()
                     if cancel_check is not None and cancel_check():
                         metrics.inc("search.cancelled")

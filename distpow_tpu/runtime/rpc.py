@@ -282,6 +282,15 @@ class RPCClient:
             for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(RPCError(str(err)))
+            # and tear the CONNECTION down: on a protocol violation the
+            # socket is still healthy, so without this a later go()/
+            # call() would send fine and then wait forever on a reader
+            # that no longer exists (review r4); closing makes the next
+            # send fail fast like the ConnectionError path
+            try:
+                self._sock.close()
+            except OSError:
+                pass
 
     def go(self, method: str, params: Optional[dict] = None) -> Future:
         """Async call; resolves with the result (rpc.Client.Go role)."""

@@ -461,3 +461,36 @@ def test_rpc_server_survives_adversarial_frames():
             cli.close()
     finally:
         srv.shutdown()
+
+
+def test_rpc_client_fails_fast_after_protocol_violation():
+    """A server that sends one malformed frame on a healthy connection
+    must not strand LATER calls: the client tears the connection down,
+    so subsequent calls raise instead of waiting on a dead reader
+    (review r4)."""
+    import socket
+    import struct
+    import threading
+
+    ls = socket.create_server(("127.0.0.1", 0))
+    port = ls.getsockname()[1]
+
+    def server():
+        conn, _ = ls.accept()
+        conn.recv(4096)                       # swallow the request
+        conn.sendall(struct.pack(">I", 1) + b"5")  # non-object response
+        # keep the TCP connection open: the violation alone must kill it
+        threading.Event().wait(3)
+        conn.close()
+
+    threading.Thread(target=server, daemon=True).start()
+    cli = RPCClient(f"127.0.0.1:{port}", timeout=5)
+    try:
+        with pytest.raises(RPCError):
+            cli.call("Echo.Ping", {})
+        # the follow-up call must fail promptly, not hang
+        with pytest.raises((RPCError, OSError)):
+            cli.call("Echo.Ping", {})
+    finally:
+        cli.close()
+        ls.close()

@@ -134,14 +134,23 @@ def test_search_ripemd160_model():
 def test_search_sha512_model():
     """Fifth registry model (round 4): 128-byte blocks and a 16-byte
     length field through the generic driver — the interface-generality
-    case — including the two-block-tail padding boundary and long-nonce
-    host absorption of a full 128-byte block."""
+    case."""
     from distpow_tpu.models.registry import SHA512
 
     tbs = list(range(256))
     oracle = puzzle.python_search(b"\x0a\x0b", 2, tbs, algo="sha512")
     got = search(b"\x0a\x0b", 2, tbs, model=SHA512, batch_size=1 << 13)
     assert got is not None and got.secret == oracle
+
+
+@pytest.mark.slow
+def test_search_sha512_boundary_layouts():
+    """sha512 two-block-tail padding boundary + long-nonce host
+    absorption of a full 128-byte block — each length is a fresh layout
+    (a fresh loop-form compile), so this lives in the slow set."""
+    from distpow_tpu.models.registry import SHA512
+
+    tbs = list(range(256))
     for L in (111, 112, 140):
         nonce = bytes(range(L))
         o = puzzle.python_search(nonce, 1, tbs, algo="sha512")
@@ -329,3 +338,99 @@ def test_mesh_search_cancellation():
         b"\x01", 30, list(range(256)), mesh=mesh, cancel_check=lambda: True
     )
     assert got is None
+
+
+def _fuzz_configs(rng, n, max_difficulty=3):
+    """Random (nonce, difficulty, thread_bytes) mining configs spanning
+    the layout space: padding boundaries, multi-block nonces, sub- and
+    single-byte partitions."""
+    lens = [0, 1, 7, 54, 55, 56, 59, 63, 64, 65, 100, 111, 112, 127, 128,
+            140, 200]
+    for _ in range(n):
+        nonce = bytes(rng.randrange(256) for _ in range(rng.choice(lens)))
+        difficulty = rng.randint(1, max_difficulty)
+        kind = rng.randrange(3)
+        if kind == 0:
+            tbs = list(range(256))
+        elif kind == 1:
+            size = rng.choice([2, 4, 16, 64, 128])
+            lo = rng.randrange(0, 256 - size + 1, size)
+            tbs = list(range(lo, lo + size))
+        else:
+            tbs = [rng.randrange(256)]
+        yield nonce, difficulty, tbs
+
+
+def _fuzz_against_oracle(models_algos, seed, n, max_difficulty=3):
+    import random
+
+    rng = random.Random(seed)
+    for model, algo in models_algos:
+        for nonce, difficulty, tbs in _fuzz_configs(rng, n, max_difficulty):
+            # The oracle generator is infinite, so it gets a candidate
+            # budget (an unbounded call could never return None and the
+            # exhausted arm would be dead — review r4).  The driver's
+            # max_hashes is LAUNCH-QUANTIZED (pipelined in-flight
+            # launches all count), so an exact shared budget can give
+            # up one launch earlier than the oracle; the contract
+            # tested is therefore budget-aware in each direction:
+            # - oracle found after p candidates  => the driver, allowed
+            #   p plus generous launch slack, finds the SAME secret;
+            # - oracle exhausted the budget => the driver at that exact
+            #   budget must also return None (its enumerated prefix
+            #   never exceeds its counted hashes).
+            budget = 1 << 16
+            counted = [0]
+            oracle = puzzle.python_search(
+                nonce, difficulty, tbs, algo=algo, max_candidates=budget,
+                on_progress=lambda k: counted.__setitem__(0, counted[0] + k),
+            )
+            case = (algo, nonce.hex()[:16], difficulty, tbs[0], len(tbs))
+            if oracle is None:
+                got = search(nonce, difficulty, tbs, model=model,
+                             batch_size=1 << 12, max_hashes=budget)
+                # pipelined launches legally overshoot max_hashes, so a
+                # find PAST the budget is legitimate; a find the driver
+                # claims was within it while the oracle saw none is the
+                # only real divergence (review r4)
+                assert got is None or (
+                    got.hashes_tried > budget
+                    and puzzle.check_secret(nonce, got.secret, difficulty,
+                                            algo)
+                ), case
+            else:
+                slack = (1 << 15) + 4 * (1 << 12)
+                got = search(nonce, difficulty, tbs, model=model,
+                             batch_size=1 << 12,
+                             max_hashes=counted[0] + slack)
+                assert got is not None and got.secret == oracle, case
+
+
+def test_search_differential_fuzz_fast():
+    """Seeded differential fuzz: random layouts/partitions vs the
+    hashlib oracle (md5 only here — every novel nonce length is a fresh
+    layout compile, so the fast path keeps a small n; the slow twin
+    covers the full registry).  This family of bugs is real — the
+    all-constant-tail-block crash (round 4) lived exactly in a layout
+    combination no systematic parametrization covered."""
+    from distpow_tpu.models.registry import MD5
+
+    _fuzz_against_oracle([(MD5, "md5")], seed=0xF00D, n=5)
+
+
+@pytest.mark.slow
+def test_search_differential_fuzz_all_models():
+    """The full-registry fuzz: every model, more configs (difficulty
+    capped at 2 for the 128-byte-block models — their device searches
+    pay ~3.4x sha256's op count per candidate on the CPU test mesh, so
+    deeper difficulties dominate the slow set's wall-clock)."""
+    from distpow_tpu.models.registry import (
+        MD5, RIPEMD160, SHA1, SHA256, SHA384, SHA512,
+    )
+
+    _fuzz_against_oracle(
+        [(MD5, "md5"), (SHA1, "sha1"), (SHA256, "sha256"),
+         (RIPEMD160, "ripemd160")], seed=0xBEEF, n=7)
+    _fuzz_against_oracle(
+        [(SHA512, "sha512"), (SHA384, "sha384")], seed=0xCAFE, n=6,
+        max_difficulty=2)
