@@ -243,6 +243,19 @@ def search(
                 return found
         return None
 
+    def flush_inflight_counts() -> None:
+        """Account launches still in flight at an early exit WITHOUT
+        draining them (the device completes them either way; fetching
+        would add a round trip per launch).  Keeps search.hashes equal
+        to dispatched work on every exit path — found, cancelled, or
+        budget — while SearchResult.hashes_tried remains the DRAINED
+        count (the enumeration-position bound at the find)."""
+        nonlocal hashes
+        while inflight:
+            *_, n = inflight.popleft()
+            hashes += n
+            metrics.inc("search.hashes", n)
+
     # The active() window covers every dispatch and drain: if the device
     # hangs mid-search, beats stop and the watchdog (if the worker
     # enabled it — WorkerConfig.DeviceHangTimeoutS) converts the zombie
@@ -272,6 +285,7 @@ def search(
                     n_cand = min(chunks_per_step, hi - chunk0) * tbc
                     WATCHDOG.beat()
                     if cancel_check is not None and cancel_check():
+                        flush_inflight_counts()
                         metrics.inc("search.cancelled")
                         return None
                     if max_hashes is not None and hashes >= max_hashes:
@@ -286,10 +300,12 @@ def search(
                     if len(inflight) >= pipeline_depth:
                         found = drain_one()
                         if found is not None:
+                            flush_inflight_counts()
                             metrics.inc("search.found")
                             return found
                 found = drain_all()
                 if found is not None:
+                    flush_inflight_counts()
                     metrics.inc("search.found")
                     return found
     return None

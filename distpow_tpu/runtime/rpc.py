@@ -255,6 +255,7 @@ class RPCClient:
         self._plock = threading.Lock()
         self._next_id = 0
         self._closed = False
+        self._dead: Optional[RPCError] = None  # set by the reader on death
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -276,9 +277,16 @@ class RPCClient:
             # same coverage as the server reader (review r4): an
             # oversized/undecodable/non-object response must FAIL the
             # pending futures, not strand them behind a dead reader
+            err = exc if self._closed is False else ConnectionError("client closed")
             with self._plock:
                 pending, self._pending = self._pending, {}
-            err = exc if self._closed is False else ConnectionError("client closed")
+                # the dead flag and the swap share one critical
+                # section: a concurrent go() either registered before
+                # (its future is in `pending`, failed below) or
+                # registers after (it sees _dead and fails fast) — no
+                # window where a future lands in the fresh dict with no
+                # reader to resolve it (review r4)
+                self._dead = RPCError(str(err))
             for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(RPCError(str(err)))
@@ -296,6 +304,9 @@ class RPCClient:
         """Async call; resolves with the result (rpc.Client.Go role)."""
         fut: Future = Future()
         with self._plock:
+            if self._dead is not None:
+                fut.set_exception(self._dead)
+                return fut
             self._next_id += 1
             rid = self._next_id
             self._pending[rid] = fut

@@ -386,6 +386,17 @@ def _fuzz_against_oracle(models_algos, seed, n, max_difficulty=3):
                 on_progress=lambda k: counted.__setitem__(0, counted[0] + k),
             )
             case = (algo, nonce.hex()[:16], difficulty, tbs[0], len(tbs))
+            # A segment-overrun launch may return a valid NON-CANONICAL
+            # secret (non-minimal chunk encoding, trailing zero byte)
+            # the oracle's minimal-encoding enumeration never visits —
+            # legitimate per the puzzle contract (search.py module
+            # docstring), so both arms accept it when it verifies.
+            def wrapped(res):
+                return (res is not None and res.chunk
+                        and res.chunk[-1] == 0
+                        and puzzle.check_secret(nonce, res.secret,
+                                                difficulty, algo))
+
             if oracle is None:
                 got = search(nonce, difficulty, tbs, model=model,
                              batch_size=1 << 12, max_hashes=budget)
@@ -393,7 +404,7 @@ def _fuzz_against_oracle(models_algos, seed, n, max_difficulty=3):
                 # find PAST the budget is legitimate; a find the driver
                 # claims was within it while the oracle saw none is the
                 # only real divergence (review r4)
-                assert got is None or (
+                assert got is None or wrapped(got) or (
                     got.hashes_tried > budget
                     and puzzle.check_secret(nonce, got.secret, difficulty,
                                             algo)
@@ -403,7 +414,9 @@ def _fuzz_against_oracle(models_algos, seed, n, max_difficulty=3):
                 got = search(nonce, difficulty, tbs, model=model,
                              batch_size=1 << 12,
                              max_hashes=counted[0] + slack)
-                assert got is not None and got.secret == oracle, case
+                assert got is not None and (
+                    got.secret == oracle or wrapped(got)
+                ), case
 
 
 def test_search_differential_fuzz_fast():
@@ -434,3 +447,61 @@ def test_search_differential_fuzz_all_models():
     _fuzz_against_oracle(
         [(SHA512, "sha512"), (SHA384, "sha384")], seed=0xCAFE, n=6,
         max_difficulty=2)
+
+
+def test_early_exits_account_all_dispatched_work():
+    """Every exit path — cancel mid-pipeline, found mid-pipeline — must
+    leave search.hashes equal to the TOTAL dispatched candidates,
+    including launches still in flight (the device completes them
+    either way; round 4).  A fake step factory pins launch sizes so the
+    expected totals are exact, independent of the real launch
+    multiplier."""
+    import jax.numpy as jnp
+
+    from distpow_tpu.ops.search_step import SENTINEL
+    from distpow_tpu.runtime.metrics import REGISTRY
+
+    dispatched = [0]
+
+    def make_factory(hit_on_launch=None):
+        launches = [0]
+
+        def factory(vw, extra, target_chunks, launch_steps=1):
+            chunks = 4 if vw else 1
+
+            def step(chunk0):
+                launches[0] += 1
+                dispatched[0] += chunks * 256
+                if hit_on_launch is not None and launches[0] == hit_on_launch:
+                    return jnp.uint32(0)  # flat index 0 of this launch
+                return jnp.uint32(SENTINEL)
+
+            return step, chunks
+        return factory
+
+    # cancel mid-segment with a launch in flight
+    calls = [0]
+
+    def cancel_after(n):
+        def check():
+            calls[0] += 1
+            return calls[0] > n
+        return check
+
+    dispatched[0] = 0
+    before = REGISTRY.get("search.hashes")
+    got = search(b"\x01", 30, list(range(256)), batch_size=1 << 10,
+                 cancel_check=cancel_after(6),
+                 step_factory=make_factory())
+    assert got is None
+    assert REGISTRY.get("search.hashes") - before == dispatched[0] > 0
+
+    # found mid-pipeline: the undrained trailing launch still counts.
+    # hit on launch 4 (width 1, flat index 0 -> chunk 1, tb 0 solves
+    # nothing real, so use difficulty 0 where everything solves)
+    dispatched[0] = 0
+    before = REGISTRY.get("search.hashes")
+    got = search(b"\x01", 0, list(range(256)), batch_size=1 << 10,
+                 step_factory=make_factory(hit_on_launch=4))
+    assert got is not None
+    assert REGISTRY.get("search.hashes") - before == dispatched[0] > 0
