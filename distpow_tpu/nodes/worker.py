@@ -356,12 +356,9 @@ class Worker:
         if getattr(config, "CompilationCacheDir", ""):
             # persist XLA compiles across boots (warmup becomes a cache
             # read after the first run on a machine)
-            import jax
+            from ..runtime.compile_cache import enable as enable_compile_cache
 
-            jax.config.update(
-                "jax_compilation_cache_dir", config.CompilationCacheDir
-            )
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+            enable_compile_cache(config.CompilationCacheDir)
         self.tracer = make_tracer(
             config.WorkerID, config.TracerServerAddr, config.TracerSecret,
             sink=sink,

@@ -305,7 +305,10 @@ class RPCClient:
         fut: Future = Future()
         with self._plock:
             if self._dead is not None:
-                fut.set_exception(self._dead)
+                # a FRESH instance per future: raising a shared
+                # exception object from concurrent .result() callers
+                # would interleave their __traceback__s (review r4)
+                fut.set_exception(RPCError(str(self._dead)))
                 return fut
             self._next_id += 1
             rid = self._next_id

@@ -83,6 +83,10 @@ def main() -> None:
 
     import jax
 
+    from distpow_tpu.runtime.compile_cache import enable as _enable_cache
+
+    _enable_cache()
+
     from distpow_tpu.backends import JaxBackend
     from distpow_tpu.backends.pallas_backend import PallasBackend
 

@@ -35,6 +35,9 @@ def main() -> None:
         model = sys.argv[idx]
     import jax
 
+    from distpow_tpu.runtime.compile_cache import enable as _enable_cache
+
+    _enable_cache()
     print(f"devices: {jax.devices()}", file=sys.stderr)
 
     # a tunnel death mid-sweep must not wedge the session: device_rate
