@@ -66,3 +66,19 @@ def test_split_thread_bytes():
     # more shards than bytes -> empties at the tail
     shards = partition.split_thread_bytes([7], 3)
     assert shards == [[7], [], []]
+
+
+def test_any_worker_count_covers_byte_space():
+    """The invariant the reference preserves THROUGH its quirks
+    (truncating log2, uint8 wrap, the %9 regime at >= 512 workers):
+    whatever the worker count, the union of all shards is the full
+    first-byte space — duplication allowed, gaps never (worker.go:
+    301-316; any valid secret is acceptable, a gap could hide the only
+    solution)."""
+    for n in (1, 2, 3, 5, 7, 8, 9, 15, 16, 31, 100, 255, 256, 257,
+              511, 512, 513, 1000, 1024):
+        bits = partition.worker_bits(n)
+        covered = set()
+        for wb in range(n):
+            covered.update(partition.thread_bytes(wb, bits))
+        assert covered == set(range(256)), n
