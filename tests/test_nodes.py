@@ -737,3 +737,65 @@ def test_round_ids_survive_corrupt_epoch_file(tmp_path, monkeypatch):
     handler._task_set(key, zombie)
     assert handler._task_take(key, rid_live) is None
     assert zombie.superseded and zombie.ev.is_set()
+
+
+def test_worker_restart_rejoins_service():
+    """The full worker recovery cycle (round 4): a dead worker's shard
+    is reassigned (requests keep completing), and a REPLACEMENT worker
+    booted on the same configured address rejoins fan-out with no
+    coordinator change — the reference's static worker list + lazy
+    redial contract (coordinator.go:169-172,356-368), which reassign
+    must not break."""
+    import contextlib
+
+    from distpow_tpu.nodes.worker import Worker
+    from distpow_tpu.runtime.config import WorkerConfig
+
+    s = Stack(2, failure_policy="reassign", failure_probe_secs=0.2)
+    try:
+        dead_addr = s.workers[1].bound_addr
+        coord_worker_addr = s.workers[1].config.CoordAddr
+        s.workers[1].shutdown()
+
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x71\x72", 2, timeout=30)
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+
+        # replacement on the SAME address (retry: the coordinator's
+        # redial loop can transiently self-connect the freed port)
+        s.sinks["worker2b"] = MemorySink()
+        for attempt in range(40):
+            try:
+                w2b = Worker(
+                    WorkerConfig(
+                        WorkerID="worker2b",
+                        ListenAddr=dead_addr,
+                        CoordAddr=coord_worker_addr,
+                        Backend="python",
+                    ),
+                    sink=s.sinks["worker2b"],
+                )
+                w2b.initialize_rpcs()
+                break
+            except OSError:
+                with contextlib.suppress(Exception):
+                    w2b.shutdown()
+                time.sleep(0.25)
+        else:
+            raise AssertionError("could not rebind the dead worker's port")
+        w2b.start_forwarder()
+        s.workers.append(w2b)  # Stack.close() tears it down
+
+        # a FRESH nonce fans out to the replacement and completes
+        res2 = mine_and_wait(client, b"\x73\x74", 2, timeout=30)
+        assert puzzle.check_secret(res2.nonce, res2.secret, 2)
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+            a[1] == "WorkerMine" for a in s.sinks["worker2b"].actions()
+        ):
+            time.sleep(0.05)
+        assert any(a[1] == "WorkerMine"
+                   for a in s.sinks["worker2b"].actions()), \
+            "replacement worker never participated in fan-out"
+    finally:
+        s.close()
