@@ -74,7 +74,8 @@ def _write_last_measured(record: dict) -> None:
               file=sys.stderr)
 
 
-def device_rate(step_builder, label: str, min_seconds: float = 2.0) -> float:
+def device_rate(step_builder, label: str, min_seconds: float = 2.0,
+                compile_grace: float = 900.0) -> float:
     """Sustained candidates/sec of a step(chunk0)->uint32 launcher.
 
     Adaptively scales the launch count until the timed window is at least
@@ -94,8 +95,13 @@ def device_rate(step_builder, label: str, min_seconds: float = 2.0) -> float:
     # hanging the process forever (observed 2026-07-30 ~04:37, where a
     # mid-bench outage wedged the whole measurement session)
     with WATCHDOG.active():
-        step, batch = step_builder()
-        int(step(jnp.uint32(1 << 24)))  # compile + real sync
+        # the first call is ONE uninterruptible compile+sync — it cannot
+        # beat, and the biggest graphs (sha512's limb emulation) have
+        # out-waited the 420 s window on a HEALTHY device (r4 first
+        # bench attempt): widen the window for just this call
+        with WATCHDOG.grace(compile_grace):
+            step, batch = step_builder()
+            int(step(jnp.uint32(1 << 24)))  # compile + real sync
 
         iters = 4
         while True:
@@ -242,21 +248,60 @@ def main() -> None:
     # JSON line ever emitted.  Arm the device-hang watchdog with an
     # on_hang that emits the diagnostic line and exits cleanly, so the
     # driver always records SOMETHING.  420s >> the longest legitimate
-    # beat gap (one cold kernel compile); beats come from device_rate,
-    # the roofline loop, warmup (_warm_factory), and the search driver.
+    # beat gap between launches; single first-compiles get a wider
+    # window via WATCHDOG.grace in device_rate (r4: sha512's compile
+    # out-waited 420 s on a healthy device and zeroed a run that had
+    # already measured md5 at 10 GH/s).
+    rates: dict = {}  # filled stage by stage; the hang bailout reads it
+
+    MD5_LABELS = ("serving", "xla-static", "pallas")
+
     def _hang_bailout(stale: float) -> None:
-        line = {
-            "metric": "MH/s/chip md5 pow search (device hung mid-bench)",
-            "value": 0.0,
-            "unit": "MH/s",
-            "vs_baseline": 0.0,
-        }
+        # Salvage everything measured BEFORE the hang: the md5 headline
+        # stages run first precisely so a late-stage death (a diagnostic
+        # model's compile or the e2e tail) cannot zero the round's
+        # number (r4 first attempt: 0.0 despite md5 at 10 GH/s in the
+        # same log).  Snapshot first — this runs on the monitor thread
+        # while the main thread may still be inserting (review r4: a
+        # mid-iteration insert would RuntimeError the monitor and
+        # silently disarm hang protection).
+        snap = dict(rates)
+        md5_done = {l: v for l, v in snap.items() if l in MD5_LABELS}
         lm = _read_last_measured()
-        if lm:
-            line["last_measured"] = lm
+        if md5_done:
+            lbl, best = max(md5_done.items(), key=lambda kv: kv[1])
+            if "serving" in md5_done and best <= md5_done["serving"] * 1.02:
+                lbl, best = "serving", md5_done["serving"]
+            # vs_baseline: the native 1-thread CPU baseline is machine-
+            # local and stable; recover it from the provenance file
+            # (value / vs_baseline = baseline MH/s) rather than running
+            # new work from inside the monitor thread
+            vs = 0.0
+            if lm and lm.get("vs_baseline") and lm.get("value"):
+                vs = round(best / 1e6 / (lm["value"] / lm["vs_baseline"]), 2)
+            line = {
+                "metric": f"MH/s/chip md5 pow search ({lbl} path, "
+                          f"diff=32bits; device hung during later stages)",
+                "value": round(best / 1e6, 3),
+                "unit": "MH/s",
+                "vs_baseline": vs,
+            }
+            _write_last_measured(dict(line, rates_mhs={
+                l: round(v / 1e6, 1) for l, v in snap.items()
+            }, note="partial run: device hung after these stages"))
+        else:
+            line = {
+                "metric": "MH/s/chip md5 pow search (device hung mid-bench)",
+                "value": 0.0,
+                "unit": "MH/s",
+                "vs_baseline": 0.0,
+            }
+            if lm:
+                line["last_measured"] = lm
         print(json.dumps(line), flush=True)
         print(f"[bench] device made no progress for {stale:.0f}s "
-              f"mid-run; presumed tunnel outage", file=sys.stderr)
+              f"mid-run; presumed tunnel outage; measured stages: "
+              f"{sorted(snap)}", file=sys.stderr)
         os._exit(0)
 
     WATCHDOG.start(420.0, on_hang=_hang_bailout)
@@ -296,14 +341,12 @@ def main() -> None:
         )
         return step, chunks * 256 * k
 
-    rates = {
-        "serving": device_rate(
-            serving_builder, f"serving (dynamic) step, k={k}"
-        ),
-        "xla-static": device_rate(
-            xla_static_builder, f"static-compiled step, k={k}"
-        ),
-    }
+    rates["serving"] = device_rate(
+        serving_builder, f"serving (dynamic) step, k={k}"
+    )
+    rates["xla-static"] = device_rate(
+        xla_static_builder, f"static-compiled step, k={k}"
+    )
 
     # One pallas builder import for the kernel benches; None = pallas
     # unavailable on this backend, each block then skips itself.
@@ -410,10 +453,9 @@ def main() -> None:
         print(f"[bench] roofline microbenchmark failed: {exc}",
               file=sys.stderr)
         roofline = None
-    # the md5 paths carry bare labels; every other model's lines are
-    # "<model>-<path>" (the old `"sha" not in lbl` filter would have
-    # let ripemd160 lines into the md5 headline pool)
-    MD5_LABELS = ("serving", "xla-static", "pallas")
+    # the md5 paths carry bare labels (MD5_LABELS above); every other
+    # model's lines are "<model>-<path>" (the old `"sha" not in lbl`
+    # filter would have let ripemd160 lines into the md5 headline pool)
     if roofline:
         md5_best = max(v for lbl, v in rates.items() if lbl in MD5_LABELS)
         print(f"[bench] VPU utilization (md5 best path): "

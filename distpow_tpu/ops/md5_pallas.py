@@ -82,14 +82,27 @@ LANES = 128
 # ~10.0 GH/s at (64, 512) vs 2.34 GH/s for round 1's flat (256,) grid;
 # inner auto-shrinks to divide smaller launches).  SHA-256: the round-3
 # hardware sweep (scripts/sweep_sha256_pallas.py, TPU v5e) measured
-# (16, *) at 1954 MH/s vs (8, *) at 1298 — two vregs per live value
-# beats one; at sublanes=8 the per-tile fixed cost (iota, hit
+# Hardware-swept geometries (docs/artifacts/r4b/sweep_*.log, TPU v5e,
+# 2026-07-31): sha256 (32, 256) at 2025.5 MH/s = 1.35x its XLA serving
+# step (the round-3 sweep's (16, 1024) measured 1954; sublanes=32 edged
+# it out on the re-sweep); sha1 (32, 2048) at 4335.1 MH/s = 2.28x XLA
+# (the old by-analogy (16, 1024) entry measured 3851 — the sweep bought
+# +12.5%).  At sublanes=8 the per-tile fixed cost (iota, hit
 # accumulation) is amortized over half as many candidates and dominates.
-# sha1's (16, 1024) is by analogy with the swept sha256 point (similar
-# live-set shape: a 16-word schedule window + a short working chain),
-# NOT hardware-swept yet — sweep before trusting it for serving.
-MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (16, 1024),
-                  "sha1": (16, 1024), "ripemd160": (16, 1024)}
+# ripemd160 (32, 512) measured 2840.5 MH/s = 2.25x its XLA serving
+# step (r4c sweep).  The sweep's absolute best was (24, 2048) at
+# 2895.4, but sublanes=24 gives tile = 24*128 = 3072, which does not
+# divide the power-of-two batches serving and the bench dispatch —
+# build_pallas_search_step would reject the bench batch outright and
+# serving's tile-rounding would leave a prime tile count that the
+# inner-shrink loop collapses to unswept territory (review r4) — so the
+# best power-of-two-compatible point ships (2% below the sweep max).
+# (The r4b bench's 69 MH/s ripemd160-pallas line was transient tunnel
+# degradation, not the tile: the r4c sweep re-measured the same
+# (16, 1024) geometry at 2421 MH/s minutes later, and the degradation
+# window also swallowed the sha512 compile right after.)
+MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (32, 256),
+                  "sha1": (32, 2048), "ripemd160": (32, 512)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 
 

@@ -70,6 +70,7 @@ class DeviceWatchdog:
         self._on_hang: Optional[Callable[[float], None]] = None
         self._arm_lock = threading.Lock()  # serializes acquire/release
         self._refs = 0  # acquire/release co-owners
+        self._graces: list[float] = []  # active grace windows (multiset)
         self.fired = threading.Event()
 
     @property
@@ -158,6 +159,36 @@ class DeviceWatchdog:
             with self._lock:
                 self._active -= 1
 
+    @contextmanager
+    def grace(self, seconds: float):
+        """Widen the no-progress window for ONE known-long operation.
+
+        A single XLA compile cannot beat — it is one uninterruptible
+        host call — and the largest graphs (sha512's 64-bit limb
+        emulation) have been observed to out-wait the 420 s bench
+        timeout on the tunneled backend, converting a healthy device
+        into a false ``on_hang`` (BENCH r4 first attempt, 2026-07-31).
+        Inside a ``grace(s)`` block the effective timeout is
+        ``max(timeout, s)``; a genuinely hung tunnel is still detected,
+        just ``s`` seconds later, and only for the annotated operation.
+        Nestable and thread-safe: active windows form a multiset and
+        the widest CURRENTLY-active one wins, so an inner ``grace(900)``
+        stops widening the window the moment it exits (review r4: a
+        depth-counter version leaked the inner window into the rest of
+        the outer block).  Exit re-seeds the beat clock so the normal
+        window restarts cleanly.
+        """
+        s = float(seconds)
+        with self._lock:
+            self._graces.append(s)
+            self._last_beat = monotonic()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._graces.remove(s)
+                self._last_beat = monotonic()
+
     def _monitor(self) -> None:
         poll = min(1.0, self._timeout / 4)
         while not self._stop.wait(poll):
@@ -167,14 +198,23 @@ class DeviceWatchdog:
                 # clean window
                 self._last_beat = monotonic()
                 continue
-            stale = monotonic() - self._last_beat
-            if stale > self._timeout:
+            # snapshot beat + grace state atomically: reading the beat
+            # first and the grace list second races a grace() exit in
+            # between (stale computed against the wide window's old
+            # beat, limit against the restored narrow one -> false
+            # fire on a healthy device, review r4)
+            with self._lock:
+                stale = monotonic() - self._last_beat
+                limit = self._timeout
+                if self._graces:
+                    limit = max(limit, max(self._graces))
+            if stale > limit:
                 log.critical(
                     "device watchdog: %d active device section(s) made no "
                     "progress for %.1fs (timeout %.1fs) — the accelerator "
                     "dispatch is presumed hung; exiting so the coordinator "
                     "can reassign this worker's shards",
-                    self._active, stale, self._timeout,
+                    self._active, stale, limit,
                 )
                 if self._on_hang is not None:
                     # callback first, THEN the observable event: waiters

@@ -1,0 +1,88 @@
+"""Probe: sha512 compress-form tradeoff on the CURRENT backend.
+
+The serving step compiles sha512's compression platform-keyed
+(models/sha512_jax.py: fori_loop window form on XLA:CPU, fully unrolled
+elsewhere).  On the tunneled TPU the unrolled form's first compile
+out-waited the bench watchdog's 420 s window (r4 first bench attempt) —
+this probe measures BOTH forms' compile wall-clock and steady-state
+throughput at the serving footprint, so the platform key is chosen from
+data rather than by analogy with sha256's CPU-only blowup.
+
+Usage: python scripts/probe_sha512_forms.py [lanes_log2=20]
+Prints one JSON line per form: {"form", "compile_s", "mhs"}.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, ".")
+
+from distpow_tpu.models import sha512_jax as S
+
+
+def probe(form_name: str, compress, lanes: int, reps: int = 8,
+          min_seconds: float = 2.0) -> dict:
+    init = tuple(jnp.uint32(x) for x in S.SHA512_INIT)
+
+    @jax.jit
+    def run(seed, n_reps):
+        base = lax.broadcasted_iota(jnp.uint32, (lanes,), 0) + seed
+
+        def body(i, acc):
+            # 16 x 64-bit message words as (hi, lo) pairs; mix the seed
+            # and rep index in so no round folds to a constant
+            words = []
+            for w in range(16):
+                words.append(acc ^ (base + jnp.uint32(
+                    (w * 0x9E3779B9) & 0xFFFFFFFF)))
+                words.append(base + jnp.uint32(w) + i.astype(jnp.uint32))
+            st = compress(init, words)
+            out = acc
+            for v in st:
+                out = out ^ v
+            return out
+
+        return lax.fori_loop(jnp.uint32(0), n_reps, body,
+                             base ^ jnp.uint32(0xA5A5A5A5))[0]
+
+    t0 = time.time()
+    int(run(jnp.uint32(1), jnp.uint32(1)))  # compile + sync
+    compile_s = time.time() - t0
+
+    n = reps
+    while True:
+        t0 = time.time()
+        sink = int(run(jnp.uint32(2), jnp.uint32(n)))
+        dt = time.time() - t0
+        if dt >= min_seconds or n >= 1 << 16:
+            break
+        n = max(n * 2, int(n * min_seconds / max(dt, 1e-3)) + 1)
+    del sink
+    rate = lanes * n / dt
+    rec = {"form": form_name, "compile_s": round(compile_s, 1),
+           "mhs": round(rate / 1e6, 1),
+           "detail": f"{n} reps x {lanes} lanes in {dt:.2f}s"}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main() -> None:
+    lanes = 1 << (int(sys.argv[1]) if len(sys.argv) > 1 else 20)
+    print(f"[probe] backend={jax.default_backend()} lanes={lanes}",
+          file=sys.stderr)
+    loop = probe("fori_loop", S._compress_loop, lanes)
+    unrolled = probe("unrolled", S._compress_unrolled, lanes)
+    faster = max((loop, unrolled), key=lambda r: r["mhs"])
+    print(f"[probe] faster steady-state: {faster['form']} "
+          f"({loop['mhs']} vs {unrolled['mhs']} MH/s; compiles "
+          f"{loop['compile_s']}s vs {unrolled['compile_s']}s)",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

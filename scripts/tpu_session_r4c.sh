@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Second r4 hardware batch (after the r4b queue drained): the follow-ups
+# the r4b results themselves motivated — (1) ripemd160 kernel geometry
+# sweep (its pallas tile measured 69 MH/s vs 1285 XLA serving in the r4b
+# bench: is it geometry or the tile form?), (2) the sha512 compress-form
+# probe (the unrolled form's first compile out-waited the 420 s bench
+# watchdog; is the fori_loop form competitive at a fraction of the
+# compile cost?), (3) a full bench re-run on the NEW swept geometries
+# (sha1 (32,2048) +12.5%, sha256 (32,256)) so last_measured provenance
+# reflects the shipped configuration.  Sequential, no kills (an
+# interrupted TPU client has twice wedged the tunnel for hours).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-docs/artifacts/r4c}"
+mkdir -p "$OUT"
+
+echo "=== waiting for device ($(date +%T)) ===" | tee "$OUT/session.log"
+UP=0
+for i in $(seq 1 200); do
+  timeout 150 python -c "import jax, jax.numpy as jnp; assert int(jnp.uint32(2)+jnp.uint32(3))==5" 2>"$OUT/probe.err"
+  RC=$?
+  if [ "$RC" -eq 0 ]; then
+    echo "device up at $(date +%T)" | tee -a "$OUT/session.log"
+    UP=1
+    break
+  elif [ "$RC" -ne 124 ] && [ "$RC" -ne 143 ]; then
+    echo "probe CRASHED (rc=$RC) — broken environment, aborting:" \
+      | tee -a "$OUT/session.log"
+    tail -5 "$OUT/probe.err" | tee -a "$OUT/session.log"
+    exit 1
+  fi
+  sleep 90
+done
+if [ "$UP" -ne 1 ]; then
+  echo "device never appeared; aborting session" | tee -a "$OUT/session.log"
+  exit 1
+fi
+
+echo "=== ripemd160 kernel sweep ===" | tee -a "$OUT/session.log"
+timeout 2400 python scripts/sweep_sha256_pallas.py --model ripemd160 \
+  >"$OUT/sweep_ripemd160.log" 2>&1
+tail -6 "$OUT/sweep_ripemd160.log" | tee -a "$OUT/session.log"
+
+echo "=== sha512 compress-form probe ===" | tee -a "$OUT/session.log"
+timeout 2400 python scripts/probe_sha512_forms.py 20 \
+  >"$OUT/sha512_forms.json" 2>"$OUT/sha512_forms.log"
+cat "$OUT/sha512_forms.json" | tee -a "$OUT/session.log"
+tail -3 "$OUT/sha512_forms.log" | tee -a "$OUT/session.log"
+
+echo "=== full bench (swept geometries) ===" | tee -a "$OUT/session.log"
+python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
+cat "$OUT/bench.json" | tee -a "$OUT/session.log"
+
+echo "=== done $(date +%T) ===" | tee -a "$OUT/session.log"

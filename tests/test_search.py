@@ -15,6 +15,33 @@ from distpow_tpu.parallel.search import search
 NONCES = [b"\x01\x02\x03\x04", b"\x02\x02\x02\x02", b"\xfe\xff"]
 
 
+def test_public_search_name_survives_submodule_import_order():
+    """README surface: ``from distpow_tpu.parallel import search`` must
+    yield the FUNCTION even after something imports the same-named
+    submodule first (backends/__init__ does).  The PEP 562 version
+    regressed here — the import system's ``parallel.search = <module>``
+    setattr shadowed the lazy getattr (caught by the r4 verify drive);
+    the module-class property is order-independent."""
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import warnings; warnings.simplefilter('error', ImportWarning)\n"
+        "import distpow_tpu.backends\n"  # imports parallel.search module
+        "import distpow_tpu.parallel.search\n"  # must not ImportWarning
+        "from distpow_tpu.parallel import search, search_mesh, make_mesh\n"
+        "assert callable(search), type(search)\n"
+        "assert callable(search_mesh) and callable(make_mesh)\n"
+        "print('SURFACE_OK')\n"
+    )
+    out = subprocess.run(
+        [_sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SURFACE_OK" in out.stdout
+
+
 @pytest.mark.parametrize("nonce", NONCES)
 @pytest.mark.parametrize("difficulty", [1, 2, 3])
 def test_search_matches_python_oracle_full_range(nonce, difficulty):

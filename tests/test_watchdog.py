@@ -59,6 +59,50 @@ def test_rejects_bad_timeout(dog):
         dog.start(0)
 
 
+def test_grace_widens_window_for_one_operation(dog):
+    # a single long op (a first XLA compile cannot beat) inside grace()
+    # must not fire even though it exceeds the base timeout...
+    dog.start(0.2, on_hang=lambda s: None)
+    with dog.active():
+        with dog.grace(5.0):
+            time.sleep(0.6)  # 3x the base timeout, under the grace
+        assert not dog.fired.is_set()
+        # ...and leaving the block restores the normal window
+        assert dog.fired.wait(2.0), "base timeout not restored after grace"
+
+
+def test_grace_still_fires_when_exceeded(dog):
+    fired = []
+    dog.start(0.1, on_hang=fired.append)
+    with dog.active():
+        with dog.grace(0.3):
+            assert dog.fired.wait(2.0), "hang under grace never detected"
+    assert fired and fired[0] >= 0.3
+
+
+def test_nested_grace_widest_wins(dog):
+    dog.start(0.1, on_hang=lambda s: None)
+    with dog.active():
+        with dog.grace(5.0):
+            with dog.grace(0.2):
+                # inner narrower grace must not shrink the outer window
+                time.sleep(0.5)
+            assert not dog.fired.is_set()
+
+
+def test_inner_grace_does_not_leak_into_outer_block(dog):
+    # review r4: a depth-counter implementation kept the inner 900s
+    # window active for the rest of the outer block, delaying genuine
+    # hang detection 15x
+    dog.start(0.1, on_hang=lambda s: None)
+    with dog.active():
+        with dog.grace(0.3):
+            with dog.grace(30.0):
+                pass  # wide inner grace exits immediately
+            # hang here must be caught by the outer 0.3s grace, not 30s
+            assert dog.fired.wait(2.0), "inner grace leaked into outer block"
+
+
 def test_search_driver_hang_detected():
     """A device fetch that never returns must trip the watchdog through
     parallel.search's own instrumentation (the beat in drain_one)."""
