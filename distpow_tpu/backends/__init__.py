@@ -66,16 +66,21 @@ class PythonBackend:
 def _warm_factory(factory, widths, target_chunks, tbc, max_launch) -> None:
     """Compile-and-dispatch each width's step once (tiny real launch)."""
     from ..parallel.search import launch_steps_for
-    from ..runtime.watchdog import WATCHDOG
+    from ..runtime.watchdog import FIRST_COMPILE_GRACE_S, WATCHDOG
 
-    # one beat per compiled program: the watchdog timeout needs to
-    # exceed one compile, not the whole warmup pass
+    # one beat per compiled program, and a grace window around each
+    # compile+dispatch: a single XLA compile cannot beat, and the
+    # largest graphs (sha512's 64-bit limb emulation) have out-waited a
+    # 420 s watchdog window on a HEALTHY device (r4 hardware session) —
+    # without the grace, arming DeviceHangTimeoutS would kill a sha512
+    # worker during its own boot warmup
     with WATCHDOG.active():
         for vw in widths:
             WATCHDOG.beat()
             k = launch_steps_for(int(vw), target_chunks, tbc, max_launch)
-            step, _ = factory(int(vw), b"", target_chunks, k)
-            int(step(1))  # block_until_ready via the int() conversion
+            with WATCHDOG.grace(FIRST_COMPILE_GRACE_S):
+                step, _ = factory(int(vw), b"", target_chunks, k)
+                int(step(1))  # block_until_ready via the int() conversion
 
 
 # One representative difficulty per mask-word compile bucket

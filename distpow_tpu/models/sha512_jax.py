@@ -21,13 +21,16 @@ consumes the standard uint32-word interface unchanged; only this module
 knows the words pair up.  The pure-Python twin and spec constants live
 in the jax-free ``sha512_py`` (same split as ripemd160).
 
-The 80-round graph is fully unrolled like the other accelerator forms;
-the live set (8 x 2 working limbs + a 16 x 2-limb schedule window) is
-the largest of the shipped models — if XLA's register allocation caps
-throughput the way sha256's did at ~77%, a Pallas tile with an explicit
-geometry is the known fix (docs/KERNELS.md), but parity correctness
-comes first: there is no kernel tile yet and the pallas backends fall
-back to this fused step transparently.
+The 80-round graph uses the fori_loop window form on EVERY platform —
+the r4 hardware probe inverted the sha256-style "unroll for
+accelerators" analogy on both axes (unrolled: 1681.7 s compile,
+2.4 MH/s; loop: 12.1 s, 13.9 MH/s on the TPU v5e;
+docs/artifacts/r4c/sha512_forms.json): the live set (8 x 2 working
+limbs + a 16 x 2-limb schedule window) is the largest of the shipped
+models and the unrolled form spills catastrophically.  Even the loop
+form sits far below the VPU roofline, so a Pallas tile with an
+explicit geometry is the known fix (docs/KERNELS.md); until one ships
+the pallas backends fall back to this fused step transparently.
 """
 
 from __future__ import annotations
@@ -207,10 +210,15 @@ def _compress_loop(state, words):
 
 @jax.jit
 def _sha512_compress_jit(state, words):
-    # platform-keyed like sha256/sha1: loop on XLA:CPU, unrolled elsewhere
-    if jax.default_backend() == "cpu":
-        return _compress_loop(state, words)
-    return _compress_unrolled(state, words)
+    # The loop form wins EVERYWHERE, measured, not just on XLA:CPU: the
+    # r4 hardware probe (scripts/probe_sha512_forms.py, TPU v5e via
+    # tunnel, docs/artifacts/r4c/sha512_forms.json) clocked the
+    # unrolled form at 1681.7 s compile / 2.4 MH/s vs the loop form's
+    # 12.1 s / 13.9 MH/s — the 160-limb unrolled live set spills so
+    # badly that the sha256-style "unroll for accelerators" analogy
+    # inverts on both axes.  Keep _compress_unrolled for differential
+    # tests; do not serve it.
+    return _compress_loop(state, words)
 
 
 def sha512_compress(state, words: Sequence):

@@ -101,9 +101,25 @@ LANES = 128
 # degradation, not the tile: the r4c sweep re-measured the same
 # (16, 1024) geometry at 2421 MH/s minutes later, and the degradation
 # window also swallowed the sha512 compile right after.)
+# sha512 (32, 256) measured 538.9 MH/s = 43.5x the XLA serving step's
+# 12.4 MH/s (r4c sweep — the sweep max (24, 256) at 544.7 is again not
+# power-of-two-compatible); the geometry surface is nearly flat
+# (498-545 across the whole sweep), consistent with Mosaic keeping the
+# limb live-set in VMEM at every height.  sha384 shares the tile and
+# the geometry (two extra live rounds from its truncation, same
+# structure).
 MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (32, 256),
-                  "sha1": (32, 2048), "ripemd160": (32, 512)}
+                  "sha1": (32, 2048), "ripemd160": (32, 512),
+                  "sha512": (32, 256), "sha384": (32, 256)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
+
+# Models whose tile only serves on REAL TPU hardware: interpret mode
+# (the off-TPU dev knob) would hand the fully-unrolled 64-bit limb-pair
+# graph to XLA:CPU, whose compile on that shape is pathological (>5 min
+# vs seconds for everything else).  build_pallas_search_step raises
+# ValueError for these under interpret=True and callers fall back to
+# the fused XLA step, exactly like a model with no tile at all.
+INTERPRET_XLA_FALLBACK = frozenset({"sha512", "sha384"})
 
 
 def default_geometry(model_name: str, interpret: bool = False):
@@ -389,12 +405,95 @@ def _ripemd160_tile(words, init, mask_words: int = 5):
     return tuple(combine[j]() if j >= 5 - mw else None for j in range(5))
 
 
-# model -> (tile fn, init-state words, digest words); a model has a
-# kernel iff it has an entry here, and MODEL_GEOMETRY above is checked
-# against this at import so the two can't drift apart.
-_TILE_FNS = {"md5": (_md5_tile, 4, 4), "sha256": (_sha256_tile, 8, 8),
-             "sha1": (_sha1_tile, 5, 5),
-             "ripemd160": (_ripemd160_tile, 5, 5)}
+def _sha512_tile_impl(words, init, mask_words: int, digest_words32: int):
+    """DCE'd SHA-512/384 compression on a tile, in uint32 limb pairs.
+
+    The same functional A/E form as ``_sha256_tile`` stretched to 80
+    rounds, with every 64-bit quantity carried as a (hi, lo) pair of
+    uint32 values (TPU VPUs have no uint64 lanes) using the limb algebra
+    from ``models/sha512_jax.py`` — one round is
+
+        t1   = E[r-4] + S1(E[r-1]) + Ch(E[r-1..r-3]) + (K[r] + w[r])
+        E[r] = A[r-4] + t1
+        A[r] = t1 + S0(A[r-1]) + Maj(A[r-1..r-3])
+
+    with 64-bit digest word j = ``init64[j] + A[79-j]`` (j < 4) or
+    ``init64[j] + E[83-j]`` (j >= 4), serialized hi-limb-first into the
+    uint32 digest vector.  ``mask_words`` counts trailing *uint32*
+    digest words (the shared mask_words_for bucket): the dominant
+    difficulty <= 8-nibble bucket keeps only the LOW limb of the last
+    64-bit word, so the chains stop at E[76] — three full rounds, every
+    A-side update past 72, and schedule words 77-79 are skipped.
+
+    ``words`` is ``2 * words_per_block`` uint32 entries (big-endian
+    64-bit message words, hi limb first — exactly the packing
+    template's serialization); ``init`` is 16 uint32 entries (8 pairs);
+    ``digest_words32`` is 16 (sha512) or 12 (sha384: same state, first
+    6 of 8 64-bit words emitted).  Returns ``digest_words32`` entries,
+    ``None`` where dead.
+    """
+    from ..models.sha512_jax import (
+        _add64, _add64_many, _k_pair, _rotr64, _shr64, _xor64,
+    )
+
+    mw = max(1, min(digest_words32, mask_words))
+    n64 = digest_words32 // 2
+    first_live = digest_words32 - mw  # first live uint32 digest index
+    live64 = [j for j in range(n64) if 2 * j + 1 >= first_live]
+    needA = [79 - j for j in live64 if j < 4]
+    needE = [83 - j for j in live64 if j >= 4]
+    R = max(needE + needA)  # mw >= 1 keeps the last 64-bit word live
+    maxA = max(needA + [R - 4])  # E[r] consumes A[r-4]
+
+    W = [(words[2 * i], words[2 * i + 1]) for i in range(16)]
+    for i in range(16, R + 1):
+        w15, w2 = W[i - 15], W[i - 2]
+        s0 = _xor64(_rotr64(w15, 1), _rotr64(w15, 8), _shr64(w15, 7))
+        s1 = _xor64(_rotr64(w2, 19), _rotr64(w2, 61), _shr64(w2, 6))
+        W.append(_add64_many(W[i - 16], s0, W[i - 7], s1))
+
+    ip = [(init[2 * j], init[2 * j + 1]) for j in range(8)]
+    A = {-4: ip[3], -3: ip[2], -2: ip[1], -1: ip[0]}
+    E = {-4: ip[7], -3: ip[6], -2: ip[5], -1: ip[4]}
+    for r in range(R + 1):
+        e1, f1, g1, h1 = E[r - 1], E[r - 2], E[r - 3], E[r - 4]
+        S1 = _xor64(_rotr64(e1, 14), _rotr64(e1, 18), _rotr64(e1, 41))
+        ch = ((e1[0] & f1[0]) ^ (~e1[0] & g1[0]),
+              (e1[1] & f1[1]) ^ (~e1[1] & g1[1]))
+        t1 = _add64_many(h1, S1, ch, _k_pair(r), W[r])
+        E[r] = _add64(A[r - 4], t1)
+        if r <= maxA:
+            a1, b1, c1 = A[r - 1], A[r - 2], A[r - 3]
+            S0 = _xor64(_rotr64(a1, 28), _rotr64(a1, 34), _rotr64(a1, 39))
+            maj = ((a1[0] & b1[0]) ^ (a1[0] & c1[0]) ^ (b1[0] & c1[0]),
+                   (a1[1] & b1[1]) ^ (a1[1] & c1[1]) ^ (b1[1] & c1[1]))
+            A[r] = _add64(t1, _add64(S0, maj))
+
+    out = [None] * digest_words32
+    for j in live64:
+        hi, lo = _add64(ip[j], A[79 - j] if j < 4 else E[83 - j])
+        out[2 * j], out[2 * j + 1] = hi, lo
+    return tuple(out)
+
+
+def _sha512_tile(words, init, mask_words: int = 16):
+    return _sha512_tile_impl(words, init, mask_words, 16)
+
+
+def _sha384_tile(words, init, mask_words: int = 12):
+    # same compression and full 16-word state; digest = first 6 of the
+    # 8 64-bit state words (models/sha384_jax.py)
+    return _sha512_tile_impl(words, init, mask_words, 12)
+
+
+# model -> (tile fn, init-state words, digest words, block words); a
+# model has a kernel iff it has an entry here, and MODEL_GEOMETRY above
+# is checked against this at import so the two can't drift apart.
+_TILE_FNS = {"md5": (_md5_tile, 4, 4, 16), "sha256": (_sha256_tile, 8, 8, 16),
+             "sha1": (_sha1_tile, 5, 5, 16),
+             "ripemd160": (_ripemd160_tile, 5, 5, 16),
+             "sha512": (_sha512_tile, 16, 16, 32),
+             "sha384": (_sha384_tile, 16, 12, 32)}
 assert set(_TILE_FNS) == set(MODEL_GEOMETRY), \
     "every pallas kernel model needs a MODEL_GEOMETRY entry and vice versa"
 
@@ -431,7 +530,7 @@ def _dyn_pallas_step(
     ``_md5_tile``, matching the DCE XLA applies to the fused step.
     """
     tile = sublanes * LANES
-    tile_fn, state_words, digest_words = _TILE_FNS[model_name]
+    tile_fn, state_words, digest_words, block_words = _TILE_FNS[model_name]
     mw = max(1, min(digest_words, mask_words))
 
     def kernel(chunk0_ref, init_ref, base_ref, masks_ref, part_ref, out_ref):
@@ -447,7 +546,7 @@ def _dyn_pallas_step(
             + col
         )
         init = tuple(init_ref[j] for j in range(state_words))
-        consts = [base_ref[w] for w in range(16)]
+        consts = [base_ref[w] for w in range(block_words)]
 
         def tile_candidates(f):
             """Elementwise (sublanes, LANES) array of int32 flat indices:
@@ -555,6 +654,20 @@ def build_pallas_search_step(
     if model.name not in _TILE_FNS:
         raise ValueError(
             f"pallas kernel implements {sorted(_TILE_FNS)}, not {model.name}"
+        )
+    if interpret and model.name in INTERPRET_XLA_FALLBACK:
+        # interpret mode runs the traced tile through XLA:CPU, whose
+        # compile on the fully-unrolled 64-bit limb-pair graph is
+        # pathological (the same blowup as the unrolled fused step —
+        # scripts/probe_sha512_forms.py timed out >5 min on CPU where
+        # the loop form takes seconds).  Off-TPU dev serving of these
+        # models goes through the XLA fallback; the kernel is a
+        # TPU-hardware path.  ValueError = the signal every caller
+        # (PallasBackend, the mesh step factory) already maps to a
+        # transparent fallback.
+        raise ValueError(
+            f"{model.name} pallas tile is TPU-only (interpret-mode "
+            f"XLA:CPU compile of the limb-pair graph is pathological)"
         )
     geom = default_geometry(model.name, interpret)
     if sublanes is None:

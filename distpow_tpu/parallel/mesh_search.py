@@ -278,7 +278,12 @@ def _pallas_mesh_step_factory(
     without a kernel); ``PallasMeshBackend`` catches these per width and
     falls back to the XLA mesh factory transparently.
     """
-    from ..ops.md5_pallas import LANES, MODEL_GEOMETRY, default_geometry
+    from ..ops.md5_pallas import (
+        INTERPRET_XLA_FALLBACK,
+        LANES,
+        MODEL_GEOMETRY,
+        default_geometry,
+    )
 
     n_dev = int(mesh.devices.size)
     if n_dev & (n_dev - 1):
@@ -287,6 +292,14 @@ def _pallas_mesh_step_factory(
         raise ValueError("pallas kernel requires power-of-two tb_count")
     if model.name not in MODEL_GEOMETRY:
         raise ValueError(f"no pallas kernel for model {model.name}")
+    if interpret and model.name in INTERPRET_XLA_FALLBACK:
+        # same guard as build_pallas_search_step: interpret mode would
+        # hand the unrolled limb-pair tile to XLA:CPU (pathological
+        # compile); the mesh backend maps this to its XLA fallback
+        raise ValueError(
+            f"{model.name} pallas tile is TPU-only (interpret-mode "
+            f"XLA:CPU compile of the limb-pair graph is pathological)"
+        )
     geom = default_geometry(model.name, interpret)
     if sublanes is None:
         sublanes = geom[0]

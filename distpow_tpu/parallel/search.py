@@ -43,7 +43,7 @@ from ..models import puzzle
 from ..models.registry import HashModel, get_hash_model
 from ..ops.search_step import SENTINEL, cached_search_step
 from ..runtime.metrics import REGISTRY as metrics
-from ..runtime.watchdog import WATCHDOG
+from ..runtime.watchdog import FIRST_COMPILE_GRACE_S, WATCHDOG
 
 DEFAULT_BATCH = 1 << 20
 DEFAULT_PIPELINE_DEPTH = 2
@@ -293,7 +293,19 @@ def search(
                         if found is not None:
                             metrics.inc("search.found")
                         return found
-                    res = step(chunk0 & 0xFFFFFFFF)
+                    if chunk0 == lo:
+                        # the segment's FIRST launch pays the compile
+                        # when the layout cache is cold (an unwarmed
+                        # width or model): one uninterruptible gap that
+                        # can far exceed the hang timeout for the
+                        # biggest graphs (sha512 unrolled: >22 min
+                        # observed on the tunnel) — widen the window
+                        # for just this launch so an armed watchdog
+                        # does not kill a healthy worker mid-compile
+                        with WATCHDOG.grace(FIRST_COMPILE_GRACE_S):
+                            res = step(chunk0 & 0xFFFFFFFF)
+                    else:
+                        res = step(chunk0 & 0xFFFFFFFF)
                     metrics.inc("search.launches")
                     inflight.append((res, chunk0, vw, extra, n_cand))
                     chunk0 += chunks_per_step

@@ -48,6 +48,15 @@ log = logging.getLogger("distpow.watchdog")
 # from a crash.  (Avoids the 128+signal range and small shell codes.)
 EXIT_CODE = 43
 
+# Grace window for ONE first compile+dispatch of a program (see
+# ``DeviceWatchdog.grace``).  Sized to the largest compile measured on
+# the tunneled TPU: sha512's fully-unrolled 64-bit limb-emulation
+# serving step, observed >22 min server-side (r4 hardware session —
+# scripts/probe_sha512_forms.py); every other model compiles in
+# 20-60 s.  A device that hangs during a first compile is still
+# detected, just after this window.
+FIRST_COMPILE_GRACE_S = 1800.0
+
 
 class DeviceWatchdog:
     """Monitor for device-driving sections that stop making progress.

@@ -64,12 +64,22 @@ def main() -> None:
     chunks = 8192
     k = launch_steps_for(4, chunks, 256, 1 << 28)
 
-    def xla_builder():
-        step = cached_search_step(nonce, 4, 8, 0, 256, chunks, model,
-                                  b"", k)
-        return step, chunks * 256 * k
+    from distpow_tpu.ops.md5_pallas import INTERPRET_XLA_FALLBACK
 
-    xla = rate_of(xla_builder, "XLA serving reference")
+    if model in INTERPRET_XLA_FALLBACK:
+        # sha512/sha384: the fused XLA serving step is impractical to
+        # compile on this backend (>30 min observed, r4c bench — the
+        # gap the kernel exists to close); sweep absolute rates only
+        print(f"[sweep] skipping XLA reference for {model} "
+              f"(serving-step compile impractical)", file=sys.stderr)
+        xla = None
+    else:
+        def xla_builder():
+            step = cached_search_step(nonce, 4, 8, 0, 256, chunks, model,
+                                      b"", k)
+            return step, chunks * 256 * k
+
+        xla = rate_of(xla_builder, "XLA serving reference")
 
     sublanes_set = (8, 16) if quick else (8, 16, 24, 32)
     inner_set = (512, 1024) if quick else (128, 256, 512, 1024, 2048)
@@ -99,16 +109,17 @@ def main() -> None:
 
                 r = rate_of(builder, f"sublanes={sl} inner={inner}")
                 results.append((r, sl, inner))
+                vs = f" ({r / xla:.2f}x XLA)" if xla else ""
                 print(f"  sublanes={sl:3d} inner={inner:5d}: "
-                      f"{r / 1e6:8.1f} MH/s ({r / xla:.2f}x XLA)")
+                      f"{r / 1e6:8.1f} MH/s{vs}")
             except Exception as exc:
                 print(f"  sublanes={sl:3d} inner={inner:5d}: FAILED {exc}")
 
     if results:
         results.sort(reverse=True)
         r, sl, inner = results[0]
-        print(f"\nbest: sublanes={sl} inner={inner} -> {r / 1e6:.1f} MH/s "
-              f"({r / xla:.2f}x the XLA serving step)")
+        vs = f" ({r / xla:.2f}x the XLA serving step)" if xla else ""
+        print(f"\nbest: sublanes={sl} inner={inner} -> {r / 1e6:.1f} MH/s{vs}")
         print(f"update ops/md5_pallas.py MODEL_GEOMETRY[{model!r}] = "
               f"({sl}, {inner}) if this beats the current entry")
 

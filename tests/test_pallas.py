@@ -110,8 +110,11 @@ def test_pallas_backend_serves_ripemd160_with_kernel():
 
 
 def test_pallas_backend_falls_back_for_sha512():
-    # sha512 is a REAL no-kernel model (no _TILE_FNS entry): the pallas
-    # backend must serve it through the transparent XLA fallback
+    # sha512 HAS a kernel tile since round 4, but it is TPU-only
+    # (INTERPRET_XLA_FALLBACK: the interpret-mode XLA:CPU compile of
+    # the unrolled limb-pair graph is pathological) — under
+    # interpret=True the backend must still serve through the
+    # transparent XLA fallback
     backend = PallasBackend(hash_model="sha512", batch_size=1 << 13,
                             interpret=True)
     nonce = b"\x55\x66"
@@ -233,6 +236,127 @@ def test_sha256_tile_randomized_batch_words():
             got = np.asarray(out[j])
             for lane in range(LANES_N):
                 assert int(got[lane]) == refs[lane][j], (mw, j, lane)
+
+
+def _one_block_tail_512(msg: bytes) -> tuple:
+    """Pad ``msg`` to one 128-byte SHA-512/384 block; 32 uint32 words."""
+    import struct
+
+    assert len(msg) <= 128 - 17
+    tail = (msg + b"\x80" + b"\x00" * (128 - len(msg) - 17)
+            + struct.pack(">QQ", 0, len(msg) * 8))
+    return struct.unpack(">32I", tail)
+
+
+def test_sha512_tile_matches_hashlib_all_buckets():
+    """The limb-pair SHA-512 tile (ops/md5_pallas.py _sha512_tile) must
+    reproduce hashlib's digest words for every mask-word bucket with
+    exactly the dead words elided.  Eager mode, same rationale as the
+    sha256 tile test — and doubly so here: the unrolled limb graph is
+    the very thing interpret mode refuses to compile
+    (INTERPRET_XLA_FALLBACK)."""
+    import hashlib
+    import struct
+
+    from distpow_tpu.models.sha512_py import SHA512_INIT
+    from distpow_tpu.ops.md5_pallas import _sha512_tile
+
+    msg = b"\x01\x02\x03\x04" + b"\x99\x11\x22\x33\x44"
+    words = [jnp.uint32(w) for w in _one_block_tail_512(msg)]
+    init = [jnp.uint32(s) for s in SHA512_INIT]
+    ref_words = struct.unpack(">16I", hashlib.sha512(msg).digest())
+    for mw in range(1, 17):
+        out = _sha512_tile(words, init, mw)
+        for j in range(16):
+            if out[j] is None:
+                assert j < 16 - mw, (mw, j)
+            else:
+                assert int(out[j]) == ref_words[j], (mw, j)
+        # every masked word must be present (the kernel consumes them)
+        for j in range(16 - mw, 16):
+            assert out[j] is not None, (mw, j)
+
+
+def test_sha384_tile_matches_hashlib_all_buckets():
+    """SHA-384 shares the compression; digest = first 12 uint32 words
+    (6 of 8 64-bit state words) with its own init constants — the
+    truncation must hold per bucket."""
+    import hashlib
+    import struct
+
+    from distpow_tpu.models.sha384_jax import SHA384_INIT
+    from distpow_tpu.ops.md5_pallas import _sha384_tile
+
+    msg = b"\xaa\xbb\xcc" + bytes(range(40))
+    words = [jnp.uint32(w) for w in _one_block_tail_512(msg)]
+    init = [jnp.uint32(s) for s in SHA384_INIT]
+    ref_words = struct.unpack(">12I", hashlib.sha384(msg).digest())
+    for mw in (1, 2, 3, 7, 12):
+        out = _sha384_tile(words, init, mw)
+        for j in range(12):
+            if out[j] is None:
+                assert j < 12 - mw, (mw, j)
+            else:
+                assert int(out[j]) == ref_words[j], (mw, j)
+        for j in range(12 - mw, 12):
+            assert out[j] is not None, (mw, j)
+
+
+def test_sha512_tile_randomized_batch_words():
+    """Batch-shaped message words (the kernel's real operand shape)
+    match hashlib lane-for-lane across random one-block messages."""
+    import hashlib
+    import random
+    import struct
+
+    import numpy as np
+
+    from distpow_tpu.models.sha512_py import SHA512_INIT
+    from distpow_tpu.ops.md5_pallas import _sha512_tile
+
+    rng = random.Random(7)
+    LANES_N = 8
+    msgs = [bytes(rng.randrange(256) for _ in range(rng.randrange(1, 100)))
+            for _ in range(LANES_N)]
+    blocks = [_one_block_tail_512(m) for m in msgs]
+    words = [jnp.asarray(np.array([b[j] for b in blocks], np.uint32))
+             for j in range(32)]
+    init = [jnp.uint32(s) for s in SHA512_INIT]
+    refs = [struct.unpack(">16I", hashlib.sha512(m).digest()) for m in msgs]
+    for mw in (1, 5, 16):
+        out = _sha512_tile(words, init, mw)
+        for j in range(16 - mw, 16):
+            got = np.asarray(out[j])
+            for lane in range(LANES_N):
+                assert int(got[lane]) == refs[lane][j], (mw, j, lane)
+
+
+def test_sha512_interpret_mode_falls_back():
+    """Both kernel constructors — the single-device builder AND the
+    mesh step factory (review r4: it bypassed the first guard) — must
+    refuse the limb-pair tiles under interpret=True (ValueError = the
+    transparent-fallback signal every caller maps to the XLA step)."""
+    import jax
+
+    from distpow_tpu.models.registry import get_hash_model
+    from distpow_tpu.ops.md5_pallas import build_pallas_search_step
+    from distpow_tpu.parallel.mesh_search import (
+        _pallas_mesh_step_factory,
+        make_mesh,
+    )
+
+    mesh = make_mesh(jax.devices())
+    for mname in ("sha512", "sha384"):
+        with pytest.raises(ValueError, match="TPU-only"):
+            build_pallas_search_step(
+                b"\x01\x02", 1, 3, 0, 256, 8, mname,
+                sublanes=8, interpret=True,
+            )
+        with pytest.raises(ValueError, match="TPU-only"):
+            _pallas_mesh_step_factory(
+                b"\x01\x02", 3, 0, 256, get_hash_model(mname), mesh,
+                "devices", sublanes=8, interpret=True,
+            )
 
 
 @pytest.mark.slow

@@ -142,6 +142,49 @@ def test_search_driver_hang_detected():
         WATCHDOG.stop()
 
 
+def test_slow_first_launch_compile_not_killed(monkeypatch):
+    """A cold layout's FIRST launch pays the XLA compile — one gap that
+    can far exceed the hang timeout (sha512 unrolled: >22 min on the
+    tunnel).  The driver wraps that launch in a grace window, so an
+    armed watchdog must ride out a slow first compile and still serve
+    the result."""
+    import importlib
+
+    search_mod = importlib.import_module("distpow_tpu.parallel.search")
+    search = search_mod.search
+
+    # shrink the grace so the test can also prove it expires (below)
+    monkeypatch.setattr(search_mod, "FIRST_COMPILE_GRACE_S", 5.0)
+
+    calls = {"n": 0}
+
+    def factory(vw, extra, target_chunks, launch_steps=1):
+        from distpow_tpu.ops.search_step import SENTINEL
+
+        def step(chunk0):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(0.8)  # "compile": 4x the base timeout
+
+            class Result:
+                def __int__(self):
+                    return 0 if chunk0 == 0 else SENTINEL
+
+            return Result()
+
+        return step, max(1, target_chunks)
+
+    WATCHDOG.start(0.2, on_hang=lambda s: None)
+    try:
+        res = search(b"\x01", 0, list(range(256)), step_factory=factory,
+                     pipeline_depth=1, batch_size=1 << 10)
+        assert res is not None  # difficulty 0: first candidate wins
+        assert not WATCHDOG.fired.is_set(), \
+            "watchdog killed a healthy slow first compile"
+    finally:
+        WATCHDOG.stop()
+
+
 def test_acquire_release_refcount(dog):
     dog.acquire(5.0)
     dog.acquire(9.0)  # shared; first timeout wins
