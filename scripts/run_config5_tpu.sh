@@ -1,29 +1,46 @@
 #!/usr/bin/env bash
 # BASELINE.md config-5 drive with a REAL TPU-backed worker (VERDICT r2
 # item 6): boots the full process stack — tracing server, coordinator,
-# one worker with Backend=jax on the accelerator — runs the 4-request
-# demo scenario at the given difficulty, validates the trace logs, and
-# prints wall-clocks.  Usage:
+# one worker on the accelerator — runs the 4-request demo scenario at
+# the given difficulty, validates the trace logs, and prints
+# wall-clocks.  Usage:
 #
-#   scripts/run_config5_tpu.sh [difficulty_nibbles] [outdir]
+#   scripts/run_config5_tpu.sh [difficulty_nibbles] [outdir] [backend] [model]
 #
 # Defaults: difficulty 6 (the repeat-nonce request adds 2 -> 8 nibbles
-# = 32 bits, BASELINE config 4's difficulty), outdir ./config5_run.
-# Requires the TPU to be reachable; the worker warms its layout-keyed
-# programs at boot (~20s) before serving.
+# = 32 bits, BASELINE config 4's difficulty), outdir ./config5_run,
+# Backend=jax, HashModel=md5.  `... 6 out pallas sha512` drives the
+# kernel-only limb model through the whole RPC stack.  Requires the
+# TPU to be reachable; the worker warms its layout-keyed programs at
+# boot (~20s) before serving.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DIFF="${1:-6}"
 OUT="${2:-config5_run}"
+BACKEND="${3:-jax}"
+MODEL="${4:-md5}"
+# fail fast on a typo'd backend/model instead of booting a worker that
+# dies instantly and spinning the full warmup wait against its corpse
+python - "$BACKEND" "$MODEL" <<'EOF'
+import sys
+backend, model = sys.argv[1], sys.argv[2]
+known = ("python", "jax", "jax-mesh", "mesh", "pallas-mesh", "pallas",
+         "native")  # backends/get_backend
+assert backend.lower() in known, \
+    f"unknown backend {backend!r}: {known}"
+from distpow_tpu.models.registry import get_hash_model
+get_hash_model(model)  # raises with the available list on a typo
+EOF
 rm -rf "$OUT" && mkdir -p "$OUT"
 
 python -m distpow_tpu.cli.config_gen --config-dir "$OUT" --workers 1
-python - "$OUT" <<'EOF'
+python - "$OUT" "$BACKEND" "$MODEL" <<'EOF'
 import json, sys
 d = sys.argv[1]
 w = json.load(open(f"{d}/worker_config.json"))
-w["Backend"] = "jax"
+w["Backend"] = sys.argv[2]
+w["HashModel"] = sys.argv[3]
 w["BatchSize"] = 1 << 21
 # tunnel deaths mid-run are a real occurrence (BASELINE.md provenance);
 # a hung dispatch should kill the worker visibly, not wedge the session
@@ -56,9 +73,13 @@ sleep 1
 python -m distpow_tpu.cli.worker --config "$OUT/worker_config.json" \
   --id worker1 --listen "$WADDR" >"$OUT/w1.log" 2>&1 &
 PIDS+=($!)
+WPID="${PIDS[-1]}"
 echo "waiting for worker warmup..."
 for i in $(seq 1 120); do
   grep -q "warmup done" "$OUT/w1.log" 2>/dev/null && break
+  if ! kill -0 "$WPID" 2>/dev/null; then
+    echo "worker died during boot:" && tail -15 "$OUT/w1.log" && exit 1
+  fi
   sleep 2
 done
 grep "warmup" "$OUT/w1.log" || echo "(no warmup line; proceeding)"
