@@ -352,7 +352,6 @@ def main() -> None:
     # unavailable on this backend, each block then skips itself.
     try:
         from distpow_tpu.ops.md5_pallas import (
-            INTERPRET_XLA_FALLBACK,
             MODEL_GEOMETRY,
             build_pallas_search_step,
         )
@@ -360,10 +359,6 @@ def main() -> None:
         print(f"[bench] pallas path unavailable: {exc}", file=sys.stderr)
         build_pallas_search_step = None
         MODEL_GEOMETRY = {}
-        # hardcoded, NOT empty: the serving-bench skip for these models
-        # guards a >30-min pathological XLA compile and must hold
-        # precisely when the pallas import is broken (review r4)
-        INTERPRET_XLA_FALLBACK = frozenset({"sha512", "sha384"})
     # launch multiplier shared by the slower-hash benches (1<<28 budget
     # vs the md5 benches' 1<<30: same wall time per timed window)
     k28 = launch_steps_for(4, chunks, 256, 1 << 28)
@@ -396,9 +391,13 @@ def main() -> None:
     # the sweep artifact records the one completed measurement at
     # 12.4 MH/s vs the kernel's 538.9) — a bench must not gamble half
     # an hour of a fragile tunnel window on a known-pathological
-    # compile.
-    for mname in ("sha256", "sha1", "ripemd160", "sha512", "sha384"):
-        if mname in INTERPRET_XLA_FALLBACK:
+    # compile.  (sha3_256 shares their interpret-mode fallback but its
+    # serving step is the fast-compiling fori_loop keccak, so it gets
+    # both lines.)
+    SERVING_COMPILE_IMPRACTICAL = frozenset({"sha512", "sha384"})
+    for mname in ("sha256", "sha1", "ripemd160", "sha512", "sha384",
+                  "sha3_256"):
+        if mname in SERVING_COMPILE_IMPRACTICAL:
             print(f"[bench] {mname}: serving line skipped (XLA step "
                   f"compile impractical on this backend; kernel-only "
                   f"model — docs/KERNELS.md)", file=sys.stderr)
@@ -464,6 +463,10 @@ def main() -> None:
     # sha512: same method, unrolled compress forced — the 64-bit
     # (hi, lo) limb emulation costs ~3.4x sha256's count
     SHA512_OPS_PER_HASH = 9782
+    # sha3_256: cost_analysis of the unrolled keccak TILE at the
+    # serving mask bucket (there is no unrolled XLA serving form to
+    # count — the tile IS the unrolled graph, same convention)
+    SHA3_OPS_PER_HASH = 9900
     try:
         roofline = measured_vpu_roofline()
     except Exception as exc:  # degrade like the rate sections above
@@ -488,7 +491,8 @@ def main() -> None:
                          # same compression as sha512 (truncated digest
                          # differs by two live rounds — within the
                          # count's own method noise)
-                         ("sha384", SHA512_OPS_PER_HASH)):
+                         ("sha384", SHA512_OPS_PER_HASH),
+                         ("sha3_256", SHA3_OPS_PER_HASH)):
             tag_rates = [v for l, v in rates.items()
                          if l.split("-")[0] == tag]
             if not tag_rates:

@@ -343,6 +343,7 @@ struct Md5Traits {
   static constexpr int kStateWords = 4;
   static constexpr int kDigestBytes = 16;
   static constexpr bool kBigEndianLength = false;
+  static constexpr bool kSpongePadding = false;
   static const uint32_t* Init() { return kInitState; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressMd5(state, block);
@@ -358,6 +359,7 @@ struct Sha256Traits {
   static constexpr int kStateWords = 8;
   static constexpr int kDigestBytes = 32;
   static constexpr bool kBigEndianLength = true;
+  static constexpr bool kSpongePadding = false;
   static const uint32_t* Init() { return kShaInit; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressSha256(state, block);
@@ -378,6 +380,7 @@ struct Sha1Traits {
   static constexpr int kStateWords = 5;
   static constexpr int kDigestBytes = 20;
   static constexpr bool kBigEndianLength = true;
+  static constexpr bool kSpongePadding = false;
   static const uint32_t* Init() { return kSha1Init; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressSha1(state, block);
@@ -398,6 +401,7 @@ struct Ripemd160Traits {
   static constexpr int kStateWords = 5;
   static constexpr int kDigestBytes = 20;
   static constexpr bool kBigEndianLength = false;  // MD5-style padding
+  static constexpr bool kSpongePadding = false;
   static const uint32_t* Init() { return kRmdInit; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressRipemd160(state, block);
@@ -413,6 +417,7 @@ struct Sha512Traits {
   static constexpr int kStateWords = 16;   // 8 x 64-bit as (hi, lo) pairs
   static constexpr int kDigestBytes = 64;
   static constexpr bool kBigEndianLength = true;
+  static constexpr bool kSpongePadding = false;
   static const uint32_t* Init() { return kSha512Init32; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressSha512(state, block);
@@ -441,6 +446,7 @@ struct Sha384Traits {
   static constexpr int kStateWords = 16;  // full sha512 state carried
   static constexpr int kDigestBytes = 48;  // truncated serialization
   static constexpr bool kBigEndianLength = true;
+  static constexpr bool kSpongePadding = false;
   static const uint32_t* Init() { return kSha384Init32; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressSha512(state, block);
@@ -452,6 +458,88 @@ struct Sha384Traits {
       out[4 * i + 2] = static_cast<uint8_t>(state[i] >> 8);
       out[4 * i + 3] = static_cast<uint8_t>(state[i]);
     }
+  }
+};
+
+// --- SHA3-256: Keccak-f[1600], FIPS 202 (round 4, seventh model) -----------
+// The state is carried as 50 uint32 limbs in little-endian lane
+// serialization order (LOW limb first — matching the JAX twin,
+// models/sha3_py.py); real uint64 lanes are reassembled here since C++
+// has them (same policy as CompressSha512's limbs).
+
+constexpr uint64_t kKeccakRC[24] = {
+    0x0000000000000001ull, 0x0000000000008082ull, 0x800000000000808Aull,
+    0x8000000080008000ull, 0x000000000000808Bull, 0x0000000080000001ull,
+    0x8000000080008081ull, 0x8000000000008009ull, 0x000000000000008Aull,
+    0x0000000000000088ull, 0x0000000080008009ull, 0x000000008000000Aull,
+    0x000000008000808Bull, 0x800000000000008Bull, 0x8000000000008089ull,
+    0x8000000000008003ull, 0x8000000000008002ull, 0x8000000000000080ull,
+    0x000000000000800Aull, 0x800000008000000Aull, 0x8000000080008081ull,
+    0x8000000000008080ull, 0x0000000080000001ull, 0x8000000080008008ull};
+
+// rotation offsets r[x][y], lane index = x + 5y
+constexpr int kKeccakRot[5][5] = {{0, 36, 3, 41, 18},
+                                  {1, 44, 10, 45, 2},
+                                  {62, 6, 43, 15, 61},
+                                  {28, 55, 25, 21, 56},
+                                  {27, 20, 39, 8, 14}};
+
+inline uint64_t Rotl64(uint64_t v, int n) {
+  return n == 0 ? v : (v << n) | (v >> (64 - n));
+}
+
+void KeccakF(uint64_t A[25]) {
+  for (int r = 0; r < 24; ++r) {
+    uint64_t C[5], D[5], B[25];
+    for (int x = 0; x < 5; ++x)
+      C[x] = A[x] ^ A[x + 5] ^ A[x + 10] ^ A[x + 15] ^ A[x + 20];
+    for (int x = 0; x < 5; ++x)
+      D[x] = C[(x + 4) % 5] ^ Rotl64(C[(x + 1) % 5], 1);
+    for (int i = 0; i < 25; ++i) A[i] ^= D[i % 5];
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y)
+        B[y + 5 * ((2 * x + 3 * y) % 5)] =
+            Rotl64(A[x + 5 * y], kKeccakRot[x][y]);
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        A[x + 5 * y] =
+            B[x + 5 * y] ^ (~B[(x + 1) % 5 + 5 * y] & B[(x + 2) % 5 + 5 * y]);
+    A[0] ^= kKeccakRC[r];
+  }
+}
+
+void CompressSha3(uint32_t state32[50], const uint8_t block[136]) {
+  uint64_t A[25];
+  for (int i = 0; i < 25; ++i)
+    A[i] = static_cast<uint64_t>(state32[2 * i]) |
+           (static_cast<uint64_t>(state32[2 * i + 1]) << 32);
+  for (int i = 0; i < 17; ++i) {  // rate: 17 LE lanes = 136 bytes
+    uint64_t lane = 0;
+    for (int b = 7; b >= 0; --b) lane = (lane << 8) | block[8 * i + b];
+    A[i] ^= lane;
+  }
+  KeccakF(A);
+  for (int i = 0; i < 25; ++i) {
+    state32[2 * i] = static_cast<uint32_t>(A[i]);
+    state32[2 * i + 1] = static_cast<uint32_t>(A[i] >> 32);
+  }
+}
+
+constexpr uint32_t kSha3Init[50] = {};  // the zero sponge state
+
+struct Sha3_256Traits {
+  static constexpr int kBlockBytes = 136;  // the RATE (1088 bits)
+  static constexpr int kLengthBytes = 0;   // sponge: no length field
+  static constexpr int kStateWords = 50;
+  static constexpr int kDigestBytes = 32;
+  static constexpr bool kBigEndianLength = false;  // unused
+  static constexpr bool kSpongePadding = true;     // pad10*1 + 0x06
+  static const uint32_t* Init() { return kSha3Init; }
+  static void Compress(uint32_t* state, const uint8_t* block) {
+    CompressSha3(state, block);
+  }
+  static void StoreDigest(const uint32_t* state, uint8_t* out) {
+    std::memcpy(out, state, 32);  // LE limb serialization, lo-first
   }
 };
 
@@ -512,20 +600,30 @@ void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
   const uint8_t* rem = t.nonce + absorbed;
   const size_t rem_len = t.nonce_len - absorbed;
   const size_t tail_content = rem_len + 1 + t.width;
-  const size_t tail_blocks = (tail_content + 1 + kLB + kBB - 1) / kBB;
+  // minimum padding: one byte for the sponge's merged 0x86; one byte
+  // 0x80 plus the length field for Merkle-Damgard
+  const size_t min_pad = Traits::kSpongePadding ? 1 : 1 + kLB;
+  const size_t tail_blocks = (tail_content + min_pad + kBB - 1) / kBB;
   const size_t tail_len = tail_blocks * kBB;
 
   std::memset(tail, 0, sizeof(tail));
   std::memcpy(tail, rem, rem_len);
-  tail[tail_content] = 0x80;
-  // the bit length is a uint64; a 16-byte field's high bytes stay zero
-  // (shifts >= 64 would be UB, hence the guard)
-  const uint64_t bitlen = static_cast<uint64_t>(msg_len) * 8;
-  for (size_t i = 0; i < kLB; ++i) {
-    const size_t shift = Traits::kBigEndianLength
-                             ? 8 * (kLB - 1 - i) : 8 * i;
-    tail[tail_len - kLB + i] =
-        shift < 64 ? static_cast<uint8_t>(bitlen >> shift) : 0;
+  if (Traits::kSpongePadding) {
+    // SHA-3 pad10*1 with the domain bits: 0x06 after the message,
+    // 0x80 into the last rate byte (XORs merge them when adjacent)
+    tail[tail_content] ^= 0x06;
+    tail[tail_len - 1] ^= 0x80;
+  } else {
+    tail[tail_content] = 0x80;
+    // the bit length is a uint64; a 16-byte field's high bytes stay
+    // zero (shifts >= 64 would be UB, hence the guard)
+    const uint64_t bitlen = static_cast<uint64_t>(msg_len) * 8;
+    for (size_t i = 0; i < kLB; ++i) {
+      const size_t shift = Traits::kBigEndianLength
+                               ? 8 * (kLB - 1 - i) : 8 * i;
+      tail[tail_len - kLB + i] =
+          shift < 64 ? static_cast<uint8_t>(bitlen >> shift) : 0;
+    }
   }
 
   for (uint64_t chunk = chunk_lo; chunk < chunk_hi; ++chunk) {
@@ -606,14 +704,20 @@ void DigestBuffer(const uint8_t* data, size_t len, uint8_t* out) {
   std::memset(tail, 0, sizeof(tail));
   size_t rem = len - full;
   std::memcpy(tail, data + full, rem);
-  tail[rem] = 0x80;
-  size_t tail_len = rem + 1 + kLB <= kBB ? kBB : 2 * kBB;
-  uint64_t bits = static_cast<uint64_t>(len) * 8;
-  for (size_t i = 0; i < kLB; ++i) {
-    const size_t shift = Traits::kBigEndianLength
-                             ? 8 * (kLB - 1 - i) : 8 * i;
-    tail[tail_len - kLB + i] =
-        shift < 64 ? static_cast<uint8_t>(bits >> shift) : 0;
+  const size_t min_pad = Traits::kSpongePadding ? 1 : 1 + kLB;
+  size_t tail_len = rem + min_pad <= kBB ? kBB : 2 * kBB;
+  if (Traits::kSpongePadding) {
+    tail[rem] ^= 0x06;
+    tail[tail_len - 1] ^= 0x80;
+  } else {
+    tail[rem] = 0x80;
+    uint64_t bits = static_cast<uint64_t>(len) * 8;
+    for (size_t i = 0; i < kLB; ++i) {
+      const size_t shift = Traits::kBigEndianLength
+                               ? 8 * (kLB - 1 - i) : 8 * i;
+      tail[tail_len - kLB + i] =
+          shift < 64 ? static_cast<uint8_t>(bits >> shift) : 0;
+    }
   }
   for (size_t b = 0; b < tail_len; b += kBB) Traits::Compress(state, tail + b);
   Traits::StoreDigest(state, out);
@@ -637,8 +741,8 @@ extern "C" {
 // acceptable per the puzzle contract, coordinator.go:202).
 //
 // `algo`: 0 = MD5 (reference parity), 1 = SHA-256 (the north-star hash
-// option), 2 = SHA-1, 3 = RIPEMD-160, 4 = SHA-512, 5 = SHA-384;
-// -2 on any other value.
+// option), 2 = SHA-1, 3 = RIPEMD-160, 4 = SHA-512, 5 = SHA-384,
+// 6 = SHA3-256; -2 on any other value.
 int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint32_t difficulty, uint32_t algo,
                          const uint8_t* thread_bytes,
@@ -646,7 +750,7 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint64_t chunk_count, int32_t n_threads,
                          const volatile int32_t* cancel_flag,
                          uint64_t* out_hashes, uint8_t* out_secret) {
-  if (n_tb == 0 || width > 8 || algo > 5) return -2;
+  if (n_tb == 0 || width > 8 || algo > 6) return -2;
   // a difficulty beyond the digest's nibble count would read past the
   // digest buffer in MeetsDifficulty (and the puzzle is unsatisfiable
   // anyway — the JAX paths reject it in nibble_masks)
@@ -656,7 +760,8 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
            : algo == 2 ? Sha1Traits::kDigestBytes
            : algo == 3 ? Ripemd160Traits::kDigestBytes
            : algo == 4 ? Sha512Traits::kDigestBytes
-                       : Sha384Traits::kDigestBytes);
+           : algo == 5 ? Sha384Traits::kDigestBytes
+                       : Sha3_256Traits::kDigestBytes);
   if (difficulty > max_nibbles) return -2;
   SearchTask task{nonce,        nonce_len,  difficulty,
                   thread_bytes, n_tb,       width,
@@ -675,8 +780,11 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                                  &hashes);
   } else if (algo == 4) {
     SearchRange<Sha512Traits>(task, chunk_count, n_threads, &found, &hashes);
-  } else {
+  } else if (algo == 5) {
     SearchRange<Sha384Traits>(task, chunk_count, n_threads, &found, &hashes);
+  } else {
+    SearchRange<Sha3_256Traits>(task, chunk_count, n_threads, &found,
+                                &hashes);
   }
 
   if (out_hashes) *out_hashes = hashes;
@@ -716,6 +824,10 @@ void distpow_sha512(const uint8_t* data, size_t len, uint8_t out[64]) {
 
 void distpow_sha384(const uint8_t* data, size_t len, uint8_t out[48]) {
   DigestBuffer<Sha384Traits>(data, len, out);
+}
+
+void distpow_sha3_256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  DigestBuffer<Sha3_256Traits>(data, len, out);
 }
 
 }  // extern "C"

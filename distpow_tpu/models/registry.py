@@ -17,6 +17,7 @@ from . import (
     md5_jax,
     ripemd160_jax,
     sha1_jax,
+    sha3_jax,
     sha256_jax,
     sha384_jax,
     sha512_jax,
@@ -37,6 +38,14 @@ class HashModel:
     # Size of the message-bit-length field in the padding (8 for every
     # 64-byte-block MD hash; 16 for SHA-384/512's 128-bit field).
     length_bytes: int = 8
+    # Padding family, consumed by ops/packing.build_tail_spec:
+    # "md"   — Merkle-Damgard strengthening: 0x80, zeros, bit-length
+    #          field of length_bytes in length_byteorder (all six
+    #          original models);
+    # "sha3" — the sponge's pad10*1 with the SHA-3 domain bits: 0x06
+    #          after the message, 0x80 into the LAST rate byte (the two
+    #          merge to 0x86 when adjacent), no length field.
+    padding: str = "md"
 
     @property
     def digest_bytes(self) -> int:
@@ -139,9 +148,22 @@ SHA384 = HashModel(
     length_bytes=sha384_jax.LENGTH_BYTES,
 )
 
+SHA3_256 = HashModel(
+    name="sha3_256",
+    block_bytes=sha3_jax.BLOCK_BYTES,      # the RATE (1088 bits)
+    digest_words=sha3_jax.DIGEST_WORDS,    # 8 of the 50 carried limbs
+    word_byteorder=sha3_jax.WORD_BYTEORDER,
+    length_byteorder=sha3_jax.LENGTH_BYTEORDER,  # unused (sponge)
+    init_state=sha3_jax.SHA3_INIT,
+    compress=sha3_jax.sha3_256_compress,   # sponge absorb: XOR + permute
+    py_compress=sha3_jax.py_compress,
+    py_absorb=sha3_jax.py_absorb,
+    padding="sha3",
+)
+
 _REGISTRY: Dict[str, HashModel] = {
     "md5": MD5, "sha256": SHA256, "sha1": SHA1, "ripemd160": RIPEMD160,
-    "sha512": SHA512, "sha384": SHA384,
+    "sha512": SHA512, "sha384": SHA384, "sha3_256": SHA3_256,
 }
 
 

@@ -1,0 +1,157 @@
+"""SHA3-256 as a vectorized JAX computation over uint32 limb pairs.
+
+Keccak-f[1600] on 25 lanes carried as (lo, hi) uint32 pairs in
+little-endian serialization order (sha3_py.py module docstring; note
+the limb order is the OPPOSITE of sha512's big-endian hi-first pairs).
+``sha3_256_compress(state, words)`` implements the sponge absorb the
+generic layers expect of a ``HashModel.compress``: XOR the 34 rate
+words into the leading state limbs, then permute.
+
+Form: ``lax.fori_loop`` over the 24 rounds — the per-round structure
+(theta / rho+pi / chi / iota) is identical across rounds except the
+round constant, which indexes a (24,)-shaped table, so the loop body
+compiles once.  The carry is the 50-limb tuple with every limb
+broadcast to one common shape up front: a sponge XORs batch-varying
+message words into a zero state, leaving mixed scalar/batch limbs that
+a fori_loop carry cannot hold (carry shapes must be invariant), and
+theta spreads the batch shape everywhere after one round anyway.  No
+unrolled form exists: sha512's hardware probe showed XLA's compile on
+big unrolled limb graphs is pathological on EVERY backend
+(docs/artifacts/r4c/sha512_forms.json), and keccak's ~100-limb live
+set is worse — the Pallas tile (ops/md5_pallas.py `_sha3_tile`) is the
+TPU serving path, exactly the sha512/sha384 playbook.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .sha3_py import (  # noqa: F401  (shared spec data + py twin)
+    BLOCK_BYTES,
+    DIGEST_WORDS,
+    KECCAK_RC,
+    KECCAK_ROT,
+    LENGTH_BYTEORDER,
+    RATE_LANES,
+    SHA3_INIT,
+    STATE_WORDS,
+    WORD_BYTEORDER,
+    py_absorb,
+    py_compress,
+    py_digest,
+)
+
+U32 = jnp.uint32
+
+_RC_LO = tuple(rc & 0xFFFFFFFF for rc in KECCAK_RC)
+_RC_HI = tuple((rc >> 32) & 0xFFFFFFFF for rc in KECCAK_RC)
+
+
+def _u(x):
+    return x if hasattr(x, "dtype") else jnp.uint32(int(x) & 0xFFFFFFFF)
+
+
+def _rotl64(p, n: int):
+    """rotl of a (lo, hi) pair by a STATIC amount."""
+    lo, hi = p
+    n %= 64
+    if n == 0:
+        return lo, hi
+    if n == 32:
+        return hi, lo
+    if n > 32:
+        lo, hi, n = hi, lo, n - 32
+    return (
+        (lo << n) | (hi >> (32 - n)),
+        (hi << n) | (lo >> (32 - n)),
+    )
+
+
+def _xor(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def keccak_f_pairs(lanes):
+    """Keccak-f[1600] on 25 (lo, hi) pairs (lane index = x + 5y).
+
+    The loop carry is ONE stacked (50, batch) array, not a tuple of 50:
+    under ``shard_map`` some limbs arrive axis-varying (absorbed
+    message words) and some replicated (the zero capacity limbs), and
+    a tuple carry would change varying-ness across iterations — the
+    same carry-type mismatch sha1's rolling window hit
+    (models/sha1_jax.py `_compress_loop`); stacking forces one uniform
+    varying-ness up front.
+    """
+    rc_lo = jnp.asarray(_RC_LO, U32)
+    rc_hi = jnp.asarray(_RC_HI, U32)
+
+    def round_body(r, st):
+        A = [(st[2 * i], st[2 * i + 1]) for i in range(25)]
+        C = [
+            _xor(_xor(_xor(_xor(A[x], A[x + 5]), A[x + 10]), A[x + 15]),
+                 A[x + 20])
+            for x in range(5)
+        ]
+        D = [_xor(C[(x - 1) % 5], _rotl64(C[(x + 1) % 5], 1))
+             for x in range(5)]
+        A = [_xor(A[i], D[i % 5]) for i in range(25)]
+        B = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                B[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl64(
+                    A[x + 5 * y], KECCAK_ROT[x][y]
+                )
+        A = [
+            (
+                B[x + 5 * y][0] ^ (~B[(x + 1) % 5 + 5 * y][0]
+                                   & B[(x + 2) % 5 + 5 * y][0]),
+                B[x + 5 * y][1] ^ (~B[(x + 1) % 5 + 5 * y][1]
+                                   & B[(x + 2) % 5 + 5 * y][1]),
+            )
+            for y in range(5) for x in range(5)
+        ]
+        A[0] = (A[0][0] ^ rc_lo[r], A[0][1] ^ rc_hi[r])
+        return jnp.stack([limb for pair in A for limb in pair])
+
+    st0 = jnp.stack([limb for pair in lanes for limb in pair])
+    out = lax.fori_loop(0, len(KECCAK_RC), round_body, st0)
+    return [(out[2 * i], out[2 * i + 1]) for i in range(25)]
+
+
+@jax.jit
+def _sha3_compress_jit(state, words):
+    # absorb: XOR the rate words into the leading limbs
+    limbs = [_u(state[i]) for i in range(STATE_WORDS)]
+    for i in range(2 * RATE_LANES):
+        limbs[i] = limbs[i] ^ _u(words[i])
+    # one common shape for every limb: fori_loop carries must be
+    # shape-invariant, and a sponge state mixes batch-varying absorbed
+    # limbs with still-scalar capacity limbs
+    limbs = jnp.broadcast_arrays(*limbs)
+    lanes = [(limbs[2 * i], limbs[2 * i + 1]) for i in range(25)]
+    out = keccak_f_pairs(lanes)
+    flat = []
+    for lo, hi in out:
+        flat.extend((lo, hi))
+    return tuple(flat)
+
+
+def sha3_256_compress(state, words: Sequence):
+    """One SHA3-256 sponge absorb step, vectorized.
+
+    ``state`` is 50 uint32 limbs (lo-first per lane); ``words`` is 34
+    broadcast-compatible uint32 entries — one 136-byte rate block in
+    little-endian serialization order, exactly how the packing template
+    serializes it.  Eager calls route through a module-level jit; under
+    an outer jit the nested jit is inlined.
+    """
+    # coerce python ints (e.g. raw template words) BEFORE the jit
+    # boundary: a word whose top bit is set (the 0x80 pad byte) would
+    # otherwise overflow the default int->int32 argument conversion
+    return _sha3_compress_jit(
+        tuple(_u(x) for x in state), tuple(_u(x) for x in words)
+    )

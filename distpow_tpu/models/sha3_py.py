@@ -1,0 +1,135 @@
+"""SHA3-256 spec data + pure-Python twin (jax-free).
+
+Seventh registry model (round 4) and the first NON-Merkle-Damgard
+member: Keccak is a sponge — no init vector, no length field, pad10*1
+with the SHA-3 domain byte — so it exercises the one packing-layer
+assumption the first six models shared (``HashModel.padding``,
+ops/packing.py).  FIPS 202 parameters for SHA3-256: rate 1088 bits
+(136-byte blocks, 17 lanes), capacity 512, digest 32 bytes = the first
+4 lanes of the state, serialized little-endian per 64-bit lane.
+
+The framework carries the 25-lane state as 50 uint32 limbs in
+little-endian serialization order — LOW limb first per lane (the
+opposite of sha512's big-endian hi-first pairs), so the digest is
+simply the leading 8 uint32 "words" with ``word_byteorder="little"``
+and every digest/mask/packing layer works unchanged.
+
+Oracle: hashlib.sha3_256 (guaranteed in CPython's hashlib since 3.6).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+BLOCK_BYTES = 136          # rate: 1088 bits
+DIGEST_WORDS = 8           # 32-byte digest as uint32 words
+WORD_BYTEORDER = "little"  # lane serialization
+LENGTH_BYTEORDER = "little"  # unused (sponge padding has no length field)
+STATE_WORDS = 50           # 25 lanes x 2 uint32 limbs, lo-first
+RATE_LANES = BLOCK_BYTES // 8
+
+# all-zero sponge state, in the framework's uint32-limb convention
+SHA3_INIT: Tuple[int, ...] = tuple(0 for _ in range(STATE_WORDS))
+
+MASK64 = (1 << 64) - 1
+
+# round constants, FIPS 202 / Keccak reference
+KECCAK_RC: Tuple[int, ...] = (
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+)
+
+# rotation offsets r[x][y] (x = column, y = row; lane index = x + 5y)
+KECCAK_ROT: Tuple[Tuple[int, ...], ...] = (
+    (0, 36, 3, 41, 18),
+    (1, 44, 10, 45, 2),
+    (62, 6, 43, 15, 61),
+    (28, 55, 25, 21, 56),
+    (27, 20, 39, 8, 14),
+)
+
+
+def _rotl64(v: int, n: int) -> int:
+    n %= 64
+    return ((v << n) | (v >> (64 - n))) & MASK64
+
+
+def keccak_f(lanes: List[int]) -> List[int]:
+    """Keccak-f[1600] on 25 uint64 lanes (index = x + 5y)."""
+    A = list(lanes)
+    for rc in KECCAK_RC:
+        # theta
+        C = [A[x] ^ A[x + 5] ^ A[x + 10] ^ A[x + 15] ^ A[x + 20]
+             for x in range(5)]
+        D = [C[(x - 1) % 5] ^ _rotl64(C[(x + 1) % 5], 1) for x in range(5)]
+        A = [A[i] ^ D[i % 5] for i in range(25)]
+        # rho + pi
+        B = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                B[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl64(
+                    A[x + 5 * y], KECCAK_ROT[x][y]
+                )
+        # chi
+        A = [
+            B[x + 5 * y] ^ ((~B[(x + 1) % 5 + 5 * y]) & MASK64
+                            & B[(x + 2) % 5 + 5 * y])
+            for y in range(5) for x in range(5)
+        ]
+        # iota
+        A[0] ^= rc
+    return A
+
+
+def _limbs_to_lanes(state) -> List[int]:
+    return [int(state[2 * i]) | (int(state[2 * i + 1]) << 32)
+            for i in range(25)]
+
+
+def _lanes_to_limbs(lanes) -> Tuple[int, ...]:
+    out: List[int] = []
+    for v in lanes:
+        out.append(v & 0xFFFFFFFF)
+        out.append((v >> 32) & 0xFFFFFFFF)
+    return tuple(out)
+
+
+def py_compress(state: Tuple[int, ...], block: bytes) -> Tuple[int, ...]:
+    """Absorb one 136-byte rate block: XOR into the state, permute."""
+    assert len(block) == BLOCK_BYTES
+    lanes = _limbs_to_lanes(state)
+    for i in range(RATE_LANES):
+        lanes[i] ^= int.from_bytes(block[8 * i: 8 * i + 8], "little")
+    return _lanes_to_limbs(keccak_f(lanes))
+
+
+def py_absorb(prefix: bytes) -> Tuple[Tuple[int, ...], bytes, int]:
+    """Absorb the full rate blocks of ``prefix``; return the sponge
+    state, the unabsorbed remainder, and the absorbed byte count."""
+    state: Tuple[int, ...] = SHA3_INIT
+    n_full = len(prefix) // BLOCK_BYTES
+    for b in range(n_full):
+        state = py_compress(
+            state, prefix[b * BLOCK_BYTES: (b + 1) * BLOCK_BYTES]
+        )
+    absorbed = n_full * BLOCK_BYTES
+    return state, prefix[absorbed:], absorbed
+
+
+def py_digest(message: bytes) -> bytes:
+    """SHA3-256 from the twin (oracle parity with hashlib.sha3_256)."""
+    state, rem, _ = py_absorb(message)
+    tail = bytearray(BLOCK_BYTES)
+    tail[: len(rem)] = rem
+    tail[len(rem)] ^= 0x06   # SHA-3 domain separation + first pad bit
+    tail[-1] ^= 0x80         # final pad bit (merges when len(rem)==135)
+    state = py_compress(state, bytes(tail))
+    return b"".join(
+        int(state[w]).to_bytes(4, "little") for w in range(DIGEST_WORDS)
+    )

@@ -110,7 +110,12 @@ LANES = 128
 # structure).
 MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (32, 256),
                   "sha1": (32, 2048), "ripemd160": (32, 512),
-                  "sha512": (32, 256), "sha384": (32, 256)}
+                  "sha512": (32, 256), "sha384": (32, 256),
+                  # keccak's ~100-limb live set is the largest of the
+                  # tiles and prefers the SHORTEST tile: (8, 2048)
+                  # measured 560.7 MH/s, monotonically falling to 425
+                  # at sublanes=32 (r4c sweep, docs/artifacts/r4c/)
+                  "sha3_256": (8, 2048)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 
 # Models whose tile only serves on REAL TPU hardware: interpret mode
@@ -119,7 +124,7 @@ _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 # vs seconds for everything else).  build_pallas_search_step raises
 # ValueError for these under interpret=True and callers fall back to
 # the fused XLA step, exactly like a model with no tile at all.
-INTERPRET_XLA_FALLBACK = frozenset({"sha512", "sha384"})
+INTERPRET_XLA_FALLBACK = frozenset({"sha512", "sha384", "sha3_256"})
 
 
 def default_geometry(model_name: str, interpret: bool = False):
@@ -476,6 +481,83 @@ def _sha512_tile_impl(words, init, mask_words: int, digest_words32: int):
     return tuple(out)
 
 
+def _sha3_tile(words, init, mask_words: int = 8):
+    """SHA3-256 sponge absorb on a tile: XOR + unrolled Keccak-f[1600].
+
+    Limb-pair form like the sha512 tile but in little-endian (lo, hi)
+    order (models/sha3_py.py).  ``words`` is 34 uint32 entries (one
+    136-byte rate block), ``init`` 50 (the sponge state after host
+    absorption — all zeros for short nonces).  Keccak admits no
+    chain-truncation DCE — theta mixes every lane into every other
+    each round — so the only mask-word savings is the FINAL round's
+    chi/iota, computed just for the lanes the live digest words read
+    (digest = lanes 0-3 of the final state; the dominant <=8-nibble
+    bucket needs only lane 3, skipping 24 of 25 final chi lanes).
+    Returns 8 entries, ``None`` where dead.
+    """
+    # the (lo, hi) pair rotation is shared with the fori_loop compress
+    # (keccak's little-endian lane convention — the OPPOSITE limb order
+    # from the sha512 tile's big-endian (hi, lo) pairs)
+    from ..models.sha3_jax import _rotl64 as _rotl64_lohi
+    from ..models.sha3_py import KECCAK_RC, KECCAK_ROT
+
+    mw = max(1, min(8, mask_words))
+    # digest uint32 word w = limb w%2 of lane w//2; live words w >= 8-mw
+    need_lanes = sorted({w // 2 for w in range(8 - mw, 8)})
+
+    A = []
+    for i in range(25):
+        lo, hi = init[2 * i], init[2 * i + 1]
+        if 2 * i < 34:
+            lo = lo ^ words[2 * i]
+        if 2 * i + 1 < 34:
+            hi = hi ^ words[2 * i + 1]
+        A.append((lo, hi))
+
+    for r in range(24):
+        C = [
+            (
+                A[x][0] ^ A[x + 5][0] ^ A[x + 10][0] ^ A[x + 15][0]
+                ^ A[x + 20][0],
+                A[x][1] ^ A[x + 5][1] ^ A[x + 10][1] ^ A[x + 15][1]
+                ^ A[x + 20][1],
+            )
+            for x in range(5)
+        ]
+        D = []
+        for x in range(5):
+            rl = _rotl64_lohi(C[(x + 1) % 5], 1)
+            D.append((C[(x + 4) % 5][0] ^ rl[0], C[(x + 4) % 5][1] ^ rl[1]))
+        A = [(A[i][0] ^ D[i % 5][0], A[i][1] ^ D[i % 5][1])
+             for i in range(25)]
+        B = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                B[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl64_lohi(
+                    A[x + 5 * y], KECCAK_ROT[x][y]
+                )
+        lanes = range(25) if r < 23 else need_lanes
+        A2 = [None] * 25
+        for i in lanes:
+            x, y = i % 5, i // 5
+            b0 = B[x + 5 * y]
+            b1 = B[(x + 1) % 5 + 5 * y]
+            b2 = B[(x + 2) % 5 + 5 * y]
+            A2[i] = (b0[0] ^ (~b1[0] & b2[0]), b0[1] ^ (~b1[1] & b2[1]))
+        if A2[0] is not None:
+            rc = KECCAK_RC[r]
+            A2[0] = (
+                A2[0][0] ^ jnp.uint32(rc & 0xFFFFFFFF),
+                A2[0][1] ^ jnp.uint32((rc >> 32) & 0xFFFFFFFF),
+            )
+        A = A2
+
+    out = [None] * 8
+    for w in range(8 - mw, 8):
+        out[w] = A[w // 2][w % 2]
+    return tuple(out)
+
+
 def _sha512_tile(words, init, mask_words: int = 16):
     return _sha512_tile_impl(words, init, mask_words, 16)
 
@@ -493,7 +575,8 @@ _TILE_FNS = {"md5": (_md5_tile, 4, 4, 16), "sha256": (_sha256_tile, 8, 8, 16),
              "sha1": (_sha1_tile, 5, 5, 16),
              "ripemd160": (_ripemd160_tile, 5, 5, 16),
              "sha512": (_sha512_tile, 16, 16, 32),
-             "sha384": (_sha384_tile, 16, 12, 32)}
+             "sha384": (_sha384_tile, 16, 12, 32),
+             "sha3_256": (_sha3_tile, 50, 8, 34)}
 assert set(_TILE_FNS) == set(MODEL_GEOMETRY), \
     "every pallas kernel model needs a MODEL_GEOMETRY entry and vice versa"
 

@@ -80,9 +80,15 @@ def build_tail_spec(
     state, rem, _ = model.py_absorb(nonce)
     msg_len = len(nonce) + 1 + width + len(extra_const_chunk)
 
-    # Tail layout: rem ‖ [tb] ‖ [chunk×width] ‖ extra ‖ 0x80 ‖ 0… ‖ len64
+    # Tail layout (padding="md"):
+    #   rem ‖ [tb] ‖ [chunk×width] ‖ extra ‖ 0x80 ‖ 0… ‖ len64
+    # (padding="sha3", the sponge's pad10*1 with the domain bits):
+    #   rem ‖ [tb] ‖ [chunk×width] ‖ extra ‖ 0x06 ‖ 0… ‖ 0x80
+    # where 0x06 and the final 0x80 merge to one 0x86 byte when
+    # adjacent, and there is no length field.
     content = len(rem) + 1 + width + len(extra_const_chunk)
-    n_blocks = (content + 1 + model.length_bytes + model.block_bytes - 1) \
+    min_pad = 1 if model.padding == "sha3" else 1 + model.length_bytes
+    n_blocks = (content + min_pad + model.block_bytes - 1) \
         // model.block_bytes
     tail = bytearray(n_blocks * model.block_bytes)
     tail[: len(rem)] = rem
@@ -91,12 +97,17 @@ def build_tail_spec(
     chunk_pos0 = tb_pos + 1
     extra_pos = chunk_pos0 + width
     tail[extra_pos : extra_pos + len(extra_const_chunk)] = extra_const_chunk
-    tail[extra_pos + len(extra_const_chunk)] = 0x80
-    # the bit-length field: 8 bytes for 64-byte-block hashes, 16 for
-    # SHA-384/512 (whose 2^128 length space no real message exercises —
-    # the high half is always zero here, as in every practical impl)
-    tail[-model.length_bytes:] = (msg_len * 8).to_bytes(
-        model.length_bytes, model.length_byteorder)
+    if model.padding == "sha3":
+        tail[extra_pos + len(extra_const_chunk)] ^= 0x06
+        tail[-1] ^= 0x80
+    else:
+        tail[extra_pos + len(extra_const_chunk)] = 0x80
+        # the bit-length field: 8 bytes for 64-byte-block hashes, 16 for
+        # SHA-384/512 (whose 2^128 length space no real message
+        # exercises — the high half is always zero here, as in every
+        # practical impl)
+        tail[-model.length_bytes:] = (msg_len * 8).to_bytes(
+            model.length_bytes, model.length_byteorder)
 
     fmt_order = model.word_byteorder
     base_words: List[Tuple[int, ...]] = []

@@ -64,12 +64,12 @@ def main() -> None:
     chunks = 8192
     k = launch_steps_for(4, chunks, 256, 1 << 28)
 
-    from distpow_tpu.ops.md5_pallas import INTERPRET_XLA_FALLBACK
-
-    if model in INTERPRET_XLA_FALLBACK:
-        # sha512/sha384: the fused XLA serving step is impractical to
-        # compile on this backend (>30 min observed, r4c bench — the
-        # gap the kernel exists to close); sweep absolute rates only
+    # sha512/sha384: the fused XLA serving step is impractical to
+    # compile on this backend (>30 min observed, r4c bench — the gap
+    # the kernel exists to close); sweep absolute rates only.  NOT the
+    # same set as INTERPRET_XLA_FALLBACK: sha3_256's serving step (the
+    # fori_loop keccak) compiles fine and is a useful reference.
+    if model in ("sha512", "sha384"):
         print(f"[sweep] skipping XLA reference for {model} "
               f"(serving-step compile impractical)", file=sys.stderr)
         xla = None

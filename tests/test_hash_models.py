@@ -12,6 +12,7 @@ from distpow_tpu.models import (
     md5_jax,
     ripemd160_jax,
     sha1_jax,
+    sha3_jax,
     sha256_jax,
     sha384_jax,
     sha512_jax,
@@ -20,6 +21,7 @@ from distpow_tpu.models.registry import (
     MD5,
     RIPEMD160,
     SHA1,
+    SHA3_256,
     SHA256,
     SHA384,
     SHA512,
@@ -102,14 +104,15 @@ def test_md5_jax_vectorized_batch():
     (RIPEMD160, lambda m: hashlib.new("ripemd160", m)),
     (SHA512, hashlib.sha512),
     (SHA384, hashlib.sha384),
+    (SHA3_256, hashlib.sha3_256),
 ])
-@pytest.mark.parametrize("length", [0, 5, 63, 64, 70, 128, 129])
+@pytest.mark.parametrize("length", [0, 5, 63, 64, 70, 128, 129, 135, 136, 137])
 def test_py_twins_vs_hashlib(model, href, length):
     rng = random.Random(length * 31)
     msg = bytes(rng.randrange(256) for _ in range(length))
     mod = {MD5: md5_jax, SHA256: sha256_jax, SHA1: sha1_jax,
            RIPEMD160: ripemd160_jax, SHA512: sha512_jax,
-           SHA384: sha384_jax}[model]
+           SHA384: sha384_jax, SHA3_256: sha3_jax}[model]
     assert mod.py_digest(msg) == href(msg).digest()
 
 
@@ -272,3 +275,67 @@ def test_sha384_spec_vector_and_truncation():
     oracle = puzzle.python_search(b"\x31\x41", 2, tbs, algo="sha384")
     got = search(b"\x31\x41", 2, tbs, model=SHA384, batch_size=1 << 13)
     assert got is not None and got.secret == oracle
+
+
+def test_sha3_registry_and_spec_vectors():
+    """The sponge model's registry shape + FIPS 202 vectors (the empty
+    string and 'abc' are the published SHA3-256 examples)."""
+    assert get_hash_model("sha3_256") is SHA3_256
+    assert SHA3_256.padding == "sha3" and MD5.padding == "md"
+    assert SHA3_256.block_bytes == 136 and SHA3_256.words_per_block == 34
+    assert SHA3_256.digest_words == 8 and SHA3_256.max_difficulty == 64
+    assert len(SHA3_256.init_state) == 50  # 25 lanes x 2 limbs
+    assert sha3_jax.py_digest(b"").hex() == (
+        "a7ffc6f8bf1ed76651c14756a061d662f580ff4de43b49fa82d80a4b80f8434a")
+    assert sha3_jax.py_digest(b"abc").hex() == (
+        "3a985da74fe225b2045c172d6bd390bd855f086e3e9d525b46bfe24511431532")
+
+
+def test_sha3_jax_compress_batch_vs_hashlib():
+    """The limb-pair keccak on batch-shaped words (the serving operand
+    shape) matches hashlib lane-for-lane, one-block and two-block."""
+    rng = random.Random(99)
+    N = 9
+    msgs = [bytes(rng.randrange(256) for _ in range(rng.randrange(1, 135)))
+            for _ in range(N)]
+    blocks = []
+    for m in msgs:
+        t = bytearray(136)
+        t[: len(m)] = m
+        t[len(m)] ^= 0x06
+        t[-1] ^= 0x80
+        blocks.append(struct.unpack("<34I", bytes(t)))
+    arr = np.array(blocks, np.uint32)  # (N, 34)
+    words = [jnp.asarray(arr[:, j]) for j in range(34)]
+    state = sha3_jax.sha3_256_compress(sha3_jax.SHA3_INIT, words)
+    for i, m in enumerate(msgs):
+        digest = b"".join(
+            int(np.asarray(state[w])[i]).to_bytes(4, "little")
+            for w in range(8)
+        )
+        assert digest == hashlib.sha3_256(m).digest(), i
+    # two-block path: absorbed prefix -> device continuation
+    long_msg = bytes(range(200))
+    st, rem, absorbed = sha3_jax.py_absorb(long_msg)
+    assert absorbed == 136 and len(rem) == 64
+    t = bytearray(136)
+    t[: len(rem)] = rem
+    t[len(rem)] ^= 0x06
+    t[-1] ^= 0x80
+    st = sha3_jax.sha3_256_compress(st, struct.unpack("<34I", bytes(t)))
+    digest = b"".join(int(w).to_bytes(4, "little") for w in st[:8])
+    assert digest == hashlib.sha3_256(long_msg).digest()
+
+
+def test_sha3_search_matches_oracle():
+    """Mining parity end-to-end through the generic driver — the sponge
+    padding hook (ops/packing.py) in its serving configuration."""
+    from distpow_tpu.models import puzzle
+    from distpow_tpu.parallel.search import search
+
+    tbs = list(range(256))
+    for nonce in (b"\x27\x18", b"\x01\x02\x03\x04"):
+        oracle = puzzle.python_search(nonce, 2, tbs, algo="sha3_256")
+        got = search(nonce, 2, tbs, model=SHA3_256, batch_size=1 << 13)
+        assert got is not None and got.secret == oracle
+        assert hashlib.sha3_256(nonce + got.secret).hexdigest().endswith("00")

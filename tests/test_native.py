@@ -134,6 +134,33 @@ def test_native_backend_sha384_matches_oracle():
     assert backend.search(b"\x31\x41", 2, list(range(256))) == oracle
 
 
+@pytest.mark.parametrize("length", [0, 135, 136, 137, 300])
+def test_native_sha3_vs_hashlib(length):
+    """Sha3_256Traits digest hook: the lengths bracket the 136-byte
+    rate boundary where the merged 0x86 pad byte appears."""
+    import random
+
+    rng = random.Random(7000 + length)
+    data = bytes(rng.randrange(256) for _ in range(length))
+    assert native.native_sha3_256(data) == hashlib.sha3_256(data).digest()
+
+
+def test_native_backend_sha3_matches_oracle():
+    """The sponge trait through the generic scan loop: kSpongePadding
+    exercises the pad10*1 branch of the tail writer, including a
+    long-nonce host absorption of one full 136-byte rate block."""
+    from distpow_tpu.models import puzzle
+
+    backend = native.NativeBackend("sha3_256", n_threads=1)
+    oracle = puzzle.python_search(b"\x21\x43", 2, list(range(256)),
+                                  algo="sha3_256")
+    assert backend.search(b"\x21\x43", 2, list(range(256))) == oracle
+    long_nonce = bytes(range(150))  # host-absorbs one full rate block
+    o2 = puzzle.python_search(long_nonce, 1, list(range(256)),
+                              algo="sha3_256")
+    assert backend.search(long_nonce, 1, list(range(256))) == o2
+
+
 def test_native_backend_sha1_matches_oracle():
     """Sha1Traits through the same templated scan loop: reference
     enumeration order for the third registry model too."""

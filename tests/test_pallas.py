@@ -331,6 +331,60 @@ def test_sha512_tile_randomized_batch_words():
                 assert int(got[lane]) == refs[lane][j], (mw, j, lane)
 
 
+def test_sha3_tile_matches_hashlib_all_buckets():
+    """The unrolled keccak tile (round 4, seventh model — the sponge)
+    must reproduce hashlib's digest words for every mask bucket, with
+    the final-round chi DCE eliding exactly the dead words.  Eager
+    mode, like every limb tile."""
+    import hashlib
+    import struct
+
+    from distpow_tpu.models.sha3_py import SHA3_INIT
+    from distpow_tpu.ops.md5_pallas import _sha3_tile
+
+    msg = b"\x42\x24" + bytes(range(50))
+    t = bytearray(136)
+    t[: len(msg)] = msg
+    t[len(msg)] ^= 0x06
+    t[-1] ^= 0x80
+    words = [jnp.uint32(w) for w in struct.unpack("<34I", bytes(t))]
+    init = [jnp.uint32(s) for s in SHA3_INIT]
+    ref_words = struct.unpack("<8I", hashlib.sha3_256(msg).digest())
+    for mw in range(1, 9):
+        out = _sha3_tile(words, init, mw)
+        for j in range(8):
+            if out[j] is None:
+                assert j < 8 - mw, (mw, j)
+            else:
+                assert int(out[j]) == ref_words[j], (mw, j)
+        for j in range(8 - mw, 8):
+            assert out[j] is not None, (mw, j)
+
+
+def test_sha3_tile_nonzero_absorbed_state():
+    """A long nonce host-absorbs a full rate block: the tile's XOR
+    absorb must continue from the NONZERO sponge state."""
+    import hashlib
+    import struct
+
+    from distpow_tpu.models.sha3_py import py_absorb
+    from distpow_tpu.ops.md5_pallas import _sha3_tile
+
+    long_msg = bytes(range(170))
+    st, rem, absorbed = py_absorb(long_msg)
+    assert absorbed == 136
+    t = bytearray(136)
+    t[: len(rem)] = rem
+    t[len(rem)] ^= 0x06
+    t[-1] ^= 0x80
+    words = [jnp.uint32(w) for w in struct.unpack("<34I", bytes(t))]
+    init = [jnp.uint32(s) for s in st]
+    ref_words = struct.unpack("<8I", hashlib.sha3_256(long_msg).digest())
+    out = _sha3_tile(words, init, 8)
+    for j in range(8):
+        assert int(out[j]) == ref_words[j], j
+
+
 def test_sha512_interpret_mode_falls_back():
     """Both kernel constructors — the single-device builder AND the
     mesh step factory (review r4: it bypassed the first guard) — must
@@ -346,7 +400,7 @@ def test_sha512_interpret_mode_falls_back():
     )
 
     mesh = make_mesh(jax.devices())
-    for mname in ("sha512", "sha384"):
+    for mname in ("sha512", "sha384", "sha3_256"):
         with pytest.raises(ValueError, match="TPU-only"):
             build_pallas_search_step(
                 b"\x01\x02", 1, 3, 0, 256, 8, mname,
