@@ -16,6 +16,9 @@ backend selected by ``WorkerConfig.Backend``:
                  (prefix->core + ``lax.pmin``, parallel/mesh_search.py)
 * ``native``   — C++ miner via ctypes (backends/native/), the CPU
                  performance path (every ALGO_IDS model)
+* ``auto``     — resolve from the hardware at boot: the Pallas kernel
+                 backends on TPU (mesh when >1 local device), the XLA
+                 backends elsewhere — see ``get_backend``
 
 Every backend implements ``search(nonce, difficulty, thread_bytes,
 cancel_check) -> Optional[bytes]`` returning the first solving secret in
@@ -307,6 +310,30 @@ class PallasMeshBackend(JaxMeshBackend):
 
 def get_backend(name: str, **kwargs):
     name = (name or "jax").lower()
+    if name == "auto":
+        # Resolve from the hardware, by this repo's own measurements
+        # (docs/KERNELS.md standing table): on TPU the Pallas kernel
+        # backends win for every model — dramatically for the 64-bit
+        # limb models, whose fused-XLA serving steps are impractical to
+        # even compile there (sha512: >30 min vs the kernel's ~5 s) —
+        # and a multi-device host gets the mesh variant; off-TPU the
+        # kernels don't lower, so the XLA backends serve (and the
+        # pallas backends would fall back to the same steps anyway).
+        # Deliberately NOT the config default: ``jax`` stays the
+        # documented default for reference-parity predictability, and
+        # ``auto`` imports jax, which the native-only path must not.
+        import jax
+
+        on_tpu = jax.default_backend() == "tpu"
+        # jax.devices() is the GLOBAL list (the worker runs
+        # maybe_init_distributed before building the backend), which is
+        # the right mesh-vs-single signal: the mesh backends span the
+        # global device set
+        multi = len(jax.devices()) > 1
+        name = ("pallas-mesh" if multi else "pallas") if on_tpu else \
+            ("jax-mesh" if multi else "jax")
+        log.info("backend auto -> %s (platform=%s, %d global device(s))",
+                 name, jax.default_backend(), len(jax.devices()))
     if name == "python":
         return PythonBackend(**kwargs)
     if name == "jax":

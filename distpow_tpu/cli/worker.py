@@ -2,7 +2,7 @@
 
     python -m distpow_tpu.cli.worker [--config PATH] [--id ID]
         [--listen ADDR]
-        [--backend {python,jax,jax-mesh,pallas,pallas-mesh,native}]
+        [--backend {python,jax,jax-mesh,pallas,pallas-mesh,native,auto}]
         [--jax-coordinator HOST:PORT --jax-num-processes N --jax-process-id I]
 
 ``--id`` and ``--listen`` override the config file the same way the

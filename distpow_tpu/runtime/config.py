@@ -7,12 +7,15 @@ config/worker_config.json, config/tracing_server_config.json via
 load unchanged.  TPU-specific extensions are additive with defaults:
 
 * ``WorkerConfig.Backend``   — miner backend: ``jax`` (single device,
-  default), ``jax-mesh`` (shard_map over all local devices), ``python``
-  (hashlib loop, the CPU-parity baseline), ``native`` (C++ miner).
+  default), ``jax-mesh`` (shard_map over all local devices), ``pallas``
+  / ``pallas-mesh`` (the hand-written TPU kernels), ``python``
+  (hashlib loop, the CPU-parity baseline), ``native`` (C++ miner), or
+  ``auto`` (resolve from the hardware at boot — the kernels on TPU,
+  mesh when multi-device; backends/__init__.py ``get_backend``).
 * ``WorkerConfig.HashModel`` — any registry model
   (models/registry.py): ``md5`` (reference parity, default),
   ``sha256`` (north-star variant), ``sha1``, ``ripemd160``,
-  ``sha512``, or ``sha384``.
+  ``sha512``, ``sha384``, or ``sha3_256``.
 * ``WorkerConfig.BatchSize`` — candidates per device launch.
 
 Unknown JSON fields are ignored (forward compatibility); missing fields

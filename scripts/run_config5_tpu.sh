@@ -26,7 +26,7 @@ python - "$BACKEND" "$MODEL" <<'EOF'
 import sys
 backend, model = sys.argv[1], sys.argv[2]
 known = ("python", "jax", "jax-mesh", "mesh", "pallas-mesh", "pallas",
-         "native")  # backends/get_backend
+         "native", "auto")  # backends/get_backend
 assert backend.lower() in known, \
     f"unknown backend {backend!r}: {known}"
 from distpow_tpu.models.registry import get_hash_model

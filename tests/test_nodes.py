@@ -799,3 +799,24 @@ def test_worker_restart_rejoins_service():
             "replacement worker never participated in fan-out"
     finally:
         s.close()
+
+
+def test_backend_auto_resolves_from_hardware():
+    """``Backend: "auto"`` resolves to the measured-best backend for
+    the hardware at boot (backends/get_backend): on this CPU test mesh
+    (8 virtual devices, conftest) that is the jax-mesh backend — on a
+    TPU it would be the pallas kernels — and the resolved backend must
+    actually serve."""
+    import jax
+
+    from distpow_tpu.backends import (
+        JaxBackend,
+        JaxMeshBackend,
+        get_backend,
+    )
+
+    backend = get_backend("auto", hash_model="md5", batch_size=1 << 13)
+    expected = JaxMeshBackend if len(jax.devices()) > 1 else JaxBackend
+    assert isinstance(backend, expected), type(backend)
+    secret = backend.search(b"\x61\x62", 2, list(range(256)))
+    assert secret == puzzle.python_search(b"\x61\x62", 2, list(range(256)))
