@@ -72,3 +72,21 @@ assert puzzle.check_secret(NONCE_P, res_p.secret, 2)
 assert res_p.secret == bytes([144, 1]), res_p.secret.hex()
 print(f"PALLAS pid={pid} secret={res_p.secret.hex()} "
       f"tb={res_p.thread_byte}", flush=True)
+
+# the sponge family through the distributed mesh.  Width-0 first
+# solutions are served by the shared single-device probe (same trap the
+# PALLAS leg documents above), so the nonce must have NONE: sha3_256
+# of 0x000a has no width-0 solution and its first solution in
+# reference chunk-major order is (chunk=1, tb=204) — verified against
+# the hashlib oracle over iter_candidates — on global device
+# 204 // 32 = 6, owned by process 1, so both processes reporting it
+# proves the structurally-different model (pad10*1, XOR-absorb,
+# 50-limb state) rides the cross-process pmin collective
+NONCE_S = bytes.fromhex("000a")
+res_s = search_mesh(NONCE_S, 2, list(range(256)), mesh=mesh,
+                    model=get_hash_model("sha3_256"), batch_size=1 << 12)
+assert res_s is not None
+assert puzzle.check_secret(NONCE_S, res_s.secret, 2, "sha3_256")
+assert res_s.secret == bytes([204, 1]), res_s.secret.hex()
+print(f"SHA3 pid={pid} secret={res_s.secret.hex()} "
+      f"tb={res_s.thread_byte}", flush=True)

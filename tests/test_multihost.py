@@ -73,3 +73,11 @@ def test_two_process_mesh_solve_crosses_processes():
     for out in outs:
         pallas = [ln for ln in out.splitlines() if ln.startswith("PALLAS")]
         assert len(pallas) == 1 and "tb=144" in pallas[0], out
+    # the sponge leg: sha3_256's first solution for 0x000a is
+    # (chunk=1, tb=204) -> device 6, process 1; the nonce has no
+    # width-0 solution, so the single-device probe cannot serve it —
+    # BOTH processes observing it means the non-Merkle-Damgard model
+    # rode the same distributed pmin collective
+    for out in outs:
+        sponge = [ln for ln in out.splitlines() if ln.startswith("SHA3")]
+        assert len(sponge) == 1 and "tb=204" in sponge[0], out
