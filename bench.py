@@ -394,10 +394,11 @@ def main() -> None:
     # compile.  (sha3_256 shares their interpret-mode fallback but its
     # serving step is the fast-compiling fori_loop keccak, so it gets
     # both lines.)
-    SERVING_COMPILE_IMPRACTICAL = frozenset({"sha512", "sha384"})
+    from distpow_tpu.ops.search_step import XLA_SERVING_COMPILE_IMPRACTICAL
+
     for mname in ("sha256", "sha1", "ripemd160", "sha512", "sha384",
                   "sha3_256"):
-        if mname in SERVING_COMPILE_IMPRACTICAL:
+        if mname in XLA_SERVING_COMPILE_IMPRACTICAL:
             print(f"[bench] {mname}: serving line skipped (XLA step "
                   f"compile impractical on this backend; kernel-only "
                   f"model — docs/KERNELS.md)", file=sys.stderr)

@@ -45,14 +45,12 @@ from .sha3_py import (  # noqa: F401  (shared spec data + py twin)
     py_digest,
 )
 
+from .sha512_jax import _u  # same scalar-coercion helper, one home
+
 U32 = jnp.uint32
 
 _RC_LO = tuple(rc & 0xFFFFFFFF for rc in KECCAK_RC)
 _RC_HI = tuple((rc >> 32) & 0xFFFFFFFF for rc in KECCAK_RC)
-
-
-def _u(x):
-    return x if hasattr(x, "dtype") else jnp.uint32(int(x) & 0xFFFFFFFF)
 
 
 def _rotl64(p, n: int):

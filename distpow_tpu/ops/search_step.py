@@ -42,6 +42,16 @@ from .packing import TailSpec, build_tail_spec, make_words
 
 SENTINEL = 0xFFFFFFFF
 
+# Models whose fused XLA serving step is impractical to COMPILE on the
+# TPU backend (>30 min observed for sha512's limb-emulation graph in
+# both compress forms, r4c hardware session — docs/KERNELS.md): bench
+# and sweep harnesses skip their XLA serving measurements rather than
+# gamble a tunnel window, and serving routes through the Pallas kernel
+# (ops/md5_pallas.py).  Distinct from INTERPRET_XLA_FALLBACK (an
+# interpret-mode/XLA:CPU property): sha3_256 is interpret-fallback but
+# its fori_loop serving step compiles fine.
+XLA_SERVING_COMPILE_IMPRACTICAL = frozenset({"sha512", "sha384"})
+
 
 def _eval_candidates(spec: TailSpec, masks, model: HashModel, tb, chunk):
     """Hash a broadcastable batch of candidates and return the hit mask."""

@@ -57,19 +57,19 @@ def main() -> None:
     WATCHDOG.start(420.0, on_hang=_bail)
 
     from distpow_tpu.ops.md5_pallas import build_pallas_search_step
-    from distpow_tpu.ops.search_step import cached_search_step
+    from distpow_tpu.ops.search_step import (
+        XLA_SERVING_COMPILE_IMPRACTICAL,
+        cached_search_step,
+    )
     from distpow_tpu.parallel.search import launch_steps_for
 
     nonce = b"\x01\x02\x03\x04"
     chunks = 8192
     k = launch_steps_for(4, chunks, 256, 1 << 28)
 
-    # sha512/sha384: the fused XLA serving step is impractical to
-    # compile on this backend (>30 min observed, r4c bench — the gap
-    # the kernel exists to close); sweep absolute rates only.  NOT the
-    # same set as INTERPRET_XLA_FALLBACK: sha3_256's serving step (the
-    # fori_loop keccak) compiles fine and is a useful reference.
-    if model in ("sha512", "sha384"):
+    if model in XLA_SERVING_COMPILE_IMPRACTICAL:
+        # sweep absolute kernel rates only — the gap the kernel exists
+        # to close (see the constant's docstring)
         print(f"[sweep] skipping XLA reference for {model} "
               f"(serving-step compile impractical)", file=sys.stderr)
         xla = None
