@@ -112,9 +112,12 @@ MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (32, 256),
                   "sha1": (32, 2048), "ripemd160": (32, 512),
                   "sha512": (32, 256), "sha384": (32, 256),
                   # keccak's ~100-limb live set is the largest of the
-                  # tiles and prefers the SHORTEST tile: (8, 2048)
-                  # measured 560.7 MH/s, monotonically falling to 425
-                  # at sublanes=32 (r4c sweep, docs/artifacts/r4c/)
+                  # tiles and prefers the SHORTEST full-vreg tile:
+                  # (8, 2048) measured 560.7 MH/s, monotonically
+                  # falling to 425 at sublanes=32 (r4c sweep,
+                  # docs/artifacts/r4c/); BELOW a vreg's 8-sublane
+                  # height the lanes go half-used — sublanes=4 measured
+                  # 285, sublanes=2 144 (r4 probe)
                   "sha3_256": (8, 2048)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 

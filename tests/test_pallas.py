@@ -331,6 +331,30 @@ def test_sha512_tile_randomized_batch_words():
                 assert int(got[lane]) == refs[lane][j], (mw, j, lane)
 
 
+def test_model_geometry_divides_serving_batches():
+    """Every shipped MODEL_GEOMETRY tile must divide the power-of-two
+    batches serving and the bench dispatch (2^21 and every smaller
+    pow2 a backend would round to).  This class of mistake has now been
+    caught twice in review — a sweep's absolute best at sublanes=24
+    gives a 3072-candidate tile that the kernel builder rejects
+    outright at bench shapes and that collapses the swept `inner` to
+    unswept territory under the backend's tile rounding — so the
+    constraint is pinned here, next to the data it guards."""
+    from distpow_tpu.ops.md5_pallas import LANES, MODEL_GEOMETRY
+
+    for mname, (sublanes, inner) in MODEL_GEOMETRY.items():
+        tile = sublanes * LANES
+        assert (1 << 21) % tile == 0, (
+            f"{mname}: tile {tile} (sublanes={sublanes}) does not divide "
+            f"the 2^21 serving batch — ship the best power-of-two-"
+            f"compatible sweep point instead"
+        )
+        assert inner & (inner - 1) == 0, (
+            f"{mname}: inner {inner} must be a power of two (the "
+            f"inner-shrink loop halves it to fit tile counts)"
+        )
+
+
 def test_sha3_tile_matches_hashlib_all_buckets():
     """The unrolled keccak tile (round 4, seventh model — the sponge)
     must reproduce hashlib's digest words for every mask bucket, with
