@@ -453,27 +453,17 @@ def main() -> None:
     # rates themselves; this one is measured by a pure rotate-add chain
     # at the serving footprint).  Ops/hash figures are XLA's own
     # cost_analysis() flop counts on the optimized serving program at
-    # difficulty 8 nibbles (mask-word DCE included): md5 584, sha256
-    # 2909 — the hand count for md5 (~650) uses the same rotate=3-ops
-    # convention and brackets the same ballpark.  MXU does not apply:
-    # the workload has no matmuls.
-    MD5_OPS_PER_HASH = 584
-    SHA256_OPS_PER_HASH = 2909
-    # sha1: cost_analysis of the serving program with the unrolled
-    # compress forced on an XLA:CPU compile — the method reproduces the
-    # TPU-measured sha256 figure exactly (2909), so the count carries
-    SHA1_OPS_PER_HASH = 1341
-    # ripemd160: same XLA:CPU cost_analysis method (its compress is
-    # always unrolled; the method re-reproduced sha1's 1341 and md5's
-    # 584 on the same build, round-4 derivation)
-    RIPEMD160_OPS_PER_HASH = 1854
-    # sha512: same method, unrolled compress forced — the 64-bit
-    # (hi, lo) limb emulation costs ~3.4x sha256's count
-    SHA512_OPS_PER_HASH = 9782
-    # sha3_256: cost_analysis of the unrolled keccak TILE at the
-    # serving mask bucket (there is no unrolled XLA serving form to
-    # count — the tile IS the unrolled graph, same convention)
-    SHA3_OPS_PER_HASH = 9900
+    # difficulty 8 nibbles (mask-word DCE included), carried as
+    # ``HashModel.cost_ops`` (models/registry.py) since the backends'
+    # launch-budget scaling consumes them too.  Derivation per model:
+    # md5/sha256 measured on the TPU compile; sha1/ripemd160/sha512 on
+    # an XLA:CPU compile with the unrolled compress forced (the method
+    # re-reproduces the TPU sha256 figure exactly); sha3_256 from the
+    # unrolled keccak TILE (there is no unrolled XLA serving form — the
+    # tile IS the unrolled graph, same convention).  The md5 hand count
+    # (~650, rotate=3-ops) brackets the same ballpark.  MXU does not
+    # apply: the workload has no matmuls.
+    MD5_OPS_PER_HASH = get_hash_model("md5").cost_ops
     try:
         roofline = measured_vpu_roofline()
     except Exception as exc:  # degrade like the rate sections above
@@ -491,15 +481,9 @@ def main() -> None:
               f"= {100 * md5_best * MD5_OPS_PER_HASH / roofline:.0f}% "
               f"(at {MD5_OPS_PER_HASH} XLA-counted ops/hash)",
               file=sys.stderr)
-        for tag, ops in (("sha256", SHA256_OPS_PER_HASH),
-                         ("sha1", SHA1_OPS_PER_HASH),
-                         ("ripemd160", RIPEMD160_OPS_PER_HASH),
-                         ("sha512", SHA512_OPS_PER_HASH),
-                         # same compression as sha512 (truncated digest
-                         # differs by two live rounds — within the
-                         # count's own method noise)
-                         ("sha384", SHA512_OPS_PER_HASH),
-                         ("sha3_256", SHA3_OPS_PER_HASH)):
+        for tag in ("sha256", "sha1", "ripemd160", "sha512", "sha384",
+                    "sha3_256"):
+            ops = get_hash_model(tag).cost_ops
             tag_rates = [v for l, v in rates.items()
                          if l.split("-")[0] == tag]
             if not tag_rates:

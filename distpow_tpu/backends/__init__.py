@@ -66,6 +66,15 @@ class PythonBackend:
         )
 
 
+def _resolve_max_launch(max_launch: Optional[int], model) -> int:
+    """One home for the backend default budget: explicit config wins;
+    otherwise the model's cost-scaled budget (review r4: this
+    expression was copy-pasted into three constructors)."""
+    from ..parallel.search import scaled_launch_candidates
+
+    return max_launch or scaled_launch_candidates(model.cost_ops)
+
+
 def _warm_factory(factory, widths, target_chunks, tbc, max_launch) -> None:
     """Compile-and-dispatch each width's step once (tiny real launch)."""
     from ..parallel.search import launch_steps_for
@@ -126,11 +135,10 @@ class JaxBackend:
     def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20,
                  max_launch: Optional[int] = None, **_):
         from ..models.registry import get_hash_model
-        from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES
 
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
-        self.max_launch = max_launch or DEFAULT_LAUNCH_CANDIDATES
+        self.max_launch = _resolve_max_launch(max_launch, self.model)
 
     def warmup(self, nonce_lens: Sequence[int], widths: Sequence[int]) -> None:
         """Pre-compile the layout-keyed programs these nonce lengths hit.
@@ -177,12 +185,11 @@ class JaxMeshBackend:
         **_,
     ):
         from ..models.registry import get_hash_model
-        from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES
 
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
         self.mesh_devices = mesh_devices
-        self.max_launch = max_launch or DEFAULT_LAUNCH_CANDIDATES
+        self.max_launch = _resolve_max_launch(max_launch, self.model)
         self._mesh = None
 
     def _get_mesh(self):

@@ -36,7 +36,7 @@ class PallasBackend:
         max_launch: Optional[int] = None,
         **_,
     ):
-        from ..parallel.search import DEFAULT_LAUNCH_CANDIDATES
+        from . import _resolve_max_launch
 
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
@@ -48,7 +48,7 @@ class PallasBackend:
         self.sublanes = sublanes if sublanes is not None else default_geom[0]
         self.inner = inner if inner is not None else default_geom[1]
         self.interpret = interpret
-        self.max_launch = max_launch or DEFAULT_LAUNCH_CANDIDATES
+        self.max_launch = _resolve_max_launch(max_launch, self.model)
 
     def _factory(self, nonce: bytes, difficulty: int, tb_lo: int, tbc: int):
         tile = self.sublanes * LANES

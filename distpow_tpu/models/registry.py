@@ -35,6 +35,16 @@ class HashModel:
     compress: Callable         # (state, words[16]) -> state, vectorized JAX
     py_compress: Callable      # pure-Python twin, for host-side absorption
     py_absorb: Callable        # prefix -> (state, remainder, absorbed_len)
+    # Measured compute cost: XLA cost_analysis() op count per hash on
+    # the optimized difficulty<=8-nibble serving program (mask-word DCE
+    # included) — the method and per-model derivations are documented
+    # in bench.py and docs/MODELS.md.  Consumed by the bench's
+    # roofline-utilization lines and by the default per-dispatch launch
+    # budget (scaled so one launch's wall-clock — the cancellation
+    # granularity — is roughly model-independent).  REQUIRED, no
+    # default: a new slow model silently inheriting md5's count would
+    # reintroduce multi-second launch quantization (review r4).
+    cost_ops: int
     # Size of the message-bit-length field in the padding (8 for every
     # 64-byte-block MD hash; 16 for SHA-384/512's 128-bit field).
     length_bytes: int = 8
@@ -84,6 +94,7 @@ MD5 = HashModel(
     compress=md5_jax.md5_compress,
     py_compress=md5_jax.py_compress,
     py_absorb=md5_jax.py_absorb,
+    cost_ops=584,  # the launch-budget scale's reference point
 )
 
 SHA256 = HashModel(
@@ -96,6 +107,7 @@ SHA256 = HashModel(
     compress=sha256_jax.sha256_compress,
     py_compress=sha256_jax.py_compress,
     py_absorb=sha256_jax.py_absorb,
+    cost_ops=2909,
 )
 
 SHA1 = HashModel(
@@ -108,6 +120,7 @@ SHA1 = HashModel(
     compress=sha1_jax.sha1_compress,
     py_compress=sha1_jax.py_compress,
     py_absorb=sha1_jax.py_absorb,
+    cost_ops=1341,
 )
 
 RIPEMD160 = HashModel(
@@ -120,6 +133,7 @@ RIPEMD160 = HashModel(
     compress=ripemd160_jax.ripemd160_compress,
     py_compress=ripemd160_jax.py_compress,
     py_absorb=ripemd160_jax.py_absorb,
+    cost_ops=1854,
 )
 
 SHA512 = HashModel(
@@ -133,6 +147,7 @@ SHA512 = HashModel(
     py_compress=sha512_jax.py_compress,
     py_absorb=sha512_jax.py_absorb,
     length_bytes=sha512_jax.LENGTH_BYTES,
+    cost_ops=9782,
 )
 
 SHA384 = HashModel(
@@ -146,6 +161,7 @@ SHA384 = HashModel(
     py_compress=sha384_jax.py_compress,
     py_absorb=sha384_jax.py_absorb,
     length_bytes=sha384_jax.LENGTH_BYTES,
+    cost_ops=9782,
 )
 
 SHA3_256 = HashModel(
@@ -159,6 +175,7 @@ SHA3_256 = HashModel(
     py_compress=sha3_jax.py_compress,
     py_absorb=sha3_jax.py_absorb,
     padding="sha3",
+    cost_ops=9900,
 )
 
 _REGISTRY: Dict[str, HashModel] = {

@@ -55,6 +55,26 @@ DEFAULT_PIPELINE_DEPTH = 2
 DEFAULT_LAUNCH_CANDIDATES = 1 << 30
 
 
+def scaled_launch_candidates(cost_ops: int, reference_ops: int = 584) -> int:
+    """Per-dispatch candidate budget scaled by measured model cost.
+
+    ``DEFAULT_LAUNCH_CANDIDATES`` (2^30) is tuned for md5: ~0.1-0.2 s
+    of device work per launch at the measured ~10 GH/s, which bounds
+    both cancellation latency (cancel_check runs between launches) and
+    solve-time granularity (a hit surfaces when its launch drains).
+    The slower hashes at the same budget stretch one launch to 2-4 s
+    (measured: sha512/sha384/sha3 e2e solves quantized to ~2 s steps,
+    docs/artifacts/r4c/e2e_models.json) — scaling by
+    ``HashModel.cost_ops`` keeps launch wall-clock roughly
+    model-independent.  The 2^24 floor preserves dispatch
+    amortization; an explicitly configured ``MaxLaunchCandidates``
+    bypasses this entirely.
+    """
+    return max(1 << 24,
+               (DEFAULT_LAUNCH_CANDIDATES * reference_ops)
+               // max(cost_ops, reference_ops))
+
+
 def launch_steps_for(
     vw: int,
     sub_chunks: int,
@@ -166,15 +186,20 @@ def search(
     max_hashes: Optional[int] = None,
     max_width: int = 8,
     step_factory: Optional[StepFactory] = None,
-    launch_candidates: int = DEFAULT_LAUNCH_CANDIDATES,
+    launch_candidates: Optional[int] = None,
 ) -> Optional[SearchResult]:
     """Find the first (reference-enumeration-order) solving secret.
 
     Returns None if cancelled or ``max_hashes`` exhausted.  ``step_factory``
     overrides the launch builder — the mesh driver (parallel/mesh_search.py)
     and the Pallas kernel path (ops/md5_pallas.py) plug in here.
+    ``launch_candidates`` defaults to the model's cost-scaled budget
+    (``scaled_launch_candidates``) so a direct library caller gets the
+    same ~0.1-0.25 s launch granularity a backend would.
     """
     model = model or get_hash_model("md5")
+    if launch_candidates is None:
+        launch_candidates = scaled_launch_candidates(model.cost_ops)
     nonce = bytes(nonce)
     tb_lo, tbc = contiguous_bounds(thread_bytes)
     if difficulty > model.max_difficulty:

@@ -97,7 +97,9 @@ class WorkerConfig:
     # BatchSize run in an on-device loop).  Dispatch+result-fetch costs a
     # host<->device round trip, so this bounds both the amortization of
     # that cost and the cancellation latency (one launch).  0 = framework
-    # default (parallel/search.py DEFAULT_LAUNCH_CANDIDATES).
+    # default: 2^30 scaled down by the model's measured cost so one
+    # launch is ~0.1-0.25 s of device work for EVERY hash model
+    # (parallel/search.py scaled_launch_candidates).
     MaxLaunchCandidates: int = 0
     # Pre-compile the layout-keyed search programs for these nonce byte
     # lengths at boot (background thread), so the first Mine RPC is pure

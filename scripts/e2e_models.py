@@ -1,0 +1,80 @@
+"""Per-model end-to-end serving latency on the current backend.
+
+For every registry model: build the ``auto``-resolved backend (the
+Pallas kernels on TPU), warm it exactly as a booted worker does, then
+solve N fresh nonces at a difficulty chosen so one solve is ~0.3-1 s
+at the model's measured rate (solve cost is exponential in difficulty
+nibbles: expected candidates = 16^d).  Prints one JSON object with
+median/p90 wall-clock per model — the serving-latency table behind
+BASELINE.md's wall-clock metric, across the whole registry, driver +
+host verification included.
+
+Usage: python scripts/e2e_models.py [n_solves=6] [outfile]
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+# difficulty per model targeting ~0.3-1 s/solve at the measured rates
+# (docs/KERNELS.md standing table)
+DIFFICULTY = {"md5": 8, "sha1": 8, "sha256": 7, "ripemd160": 7,
+              "sha512": 7, "sha384": 7, "sha3_256": 7}
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    outfile = sys.argv[2] if len(sys.argv) > 2 else None
+
+    import jax
+
+    from distpow_tpu.backends import get_backend
+    from distpow_tpu.models import puzzle
+    from distpow_tpu.runtime.compile_cache import enable
+
+    enable()
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+
+    report = {"n_solves": n, "platform": jax.default_backend(),
+              "models": {}}
+    for mname, diff in DIFFICULTY.items():
+        backend = get_backend("auto", hash_model=mname, batch_size=1 << 21)
+        t0 = time.time()
+        backend.warmup([4], [0, 1, 2, 3, 4])
+        warm_s = time.time() - t0
+        solves = []
+        for i in range(n):
+            # fresh nonce per solve, disjoint across models
+            nonce = bytes([0xA0 + i, len(mname), diff, i * 37 & 0xFF])
+            t0 = time.time()
+            secret = backend.search(nonce, diff, list(range(256)))
+            dt = time.time() - t0
+            assert secret is not None
+            assert puzzle.check_secret(nonce, secret, diff, mname)
+            solves.append(round(dt, 3))
+            print(f"[e2e] {mname} d={diff} {nonce.hex()}: {dt:.2f}s "
+                  f"secret={secret.hex()}", file=sys.stderr)
+        solves_sorted = sorted(solves)
+        report["models"][mname] = {
+            "backend": type(backend).__name__,
+            "difficulty_nibbles": diff,
+            "warmup_s": round(warm_s, 1),
+            "median_s": round(statistics.median(solves), 3),
+            "p90_s": solves_sorted[max(0, int(0.9 * n) - 1)],
+            "solves_s": solves,
+        }
+
+    line = json.dumps(report)
+    print(line)
+    if outfile:
+        with open(outfile, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -453,6 +453,37 @@ def _fuzz_against_oracle(models_algos, seed, n, max_difficulty=3):
                 ), case
 
 
+def test_scaled_launch_budget_tracks_model_cost():
+    """Backends' default per-dispatch budget scales inversely with
+    HashModel.cost_ops so one launch's wall-clock — the cancellation
+    granularity — is roughly model-independent (the fixed 2^30 budget
+    quantized sha512/sha3 solves to ~2-4 s steps,
+    docs/artifacts/r4c/e2e_models.json).  An explicit max_launch must
+    still win."""
+    from distpow_tpu.backends import JaxBackend
+    from distpow_tpu.models.registry import get_hash_model
+    from distpow_tpu.parallel.search import (
+        DEFAULT_LAUNCH_CANDIDATES,
+        scaled_launch_candidates,
+    )
+
+    md5 = get_hash_model("md5")
+    assert scaled_launch_candidates(md5.cost_ops) == DEFAULT_LAUNCH_CANDIDATES
+    prev = DEFAULT_LAUNCH_CANDIDATES + 1
+    for mname in ("md5", "sha1", "ripemd160", "sha256", "sha512"):
+        got = scaled_launch_candidates(get_hash_model(mname).cost_ops)
+        assert 1 << 24 <= got <= DEFAULT_LAUNCH_CANDIDATES
+        assert got < prev, (mname, got)  # strictly costlier -> smaller
+        prev = got
+    # floor holds even for absurd costs
+    assert scaled_launch_candidates(10**9) == 1 << 24
+    # backends consume the scale; explicit config bypasses it
+    assert JaxBackend(hash_model="sha512").max_launch == \
+        scaled_launch_candidates(get_hash_model("sha512").cost_ops)
+    assert JaxBackend(hash_model="sha512", max_launch=12345).max_launch \
+        == 12345
+
+
 def test_search_differential_fuzz_fast():
     """Seeded differential fuzz: random layouts/partitions vs the
     hashlib oracle (md5 only here — every novel nonce length is a fresh
