@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# One-command verification: native build, fast test suite, multichip
+# dryrun.  The full suite (incl. slow interpret-mode Pallas and
+# multi-process tests) is `pytest tests/ -q` (~15 min); this fast lane
+# is what a pre-commit check should run (~4 min).
+# Usage: scripts/ci.sh [--full]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== native miner build ==="
+make -C distpow_tpu/backends/native
+
+echo "=== test suite ==="
+case "${1:-}" in
+  --full) python -m pytest tests/ -q ;;
+  "")     python -m pytest tests/ -q -m "not slow" ;;
+  *)      echo "unknown argument: $1 (usage: scripts/ci.sh [--full])" >&2
+          exit 2 ;;
+esac
+
+echo "=== multichip dryrun (8 virtual devices) ==="
+python - <<'EOF'
+from __graft_entry__ import dryrun_multichip
+dryrun_multichip(8)
+EOF
+
+echo "=== ci OK ==="
