@@ -397,18 +397,19 @@ def main() -> None:
     from distpow_tpu.ops.search_step import XLA_SERVING_COMPILE_IMPRACTICAL
 
     for mname in ("sha256", "sha1", "ripemd160", "sha512", "sha384",
-                  "sha3_256"):
+                  "sha3_256", "blake2b_256"):
         if mname in XLA_SERVING_COMPILE_IMPRACTICAL:
             print(f"[bench] {mname}: serving line skipped (XLA step "
                   f"compile impractical on this backend; kernel-only "
                   f"model — docs/KERNELS.md)", file=sys.stderr)
         else:
-            # sha3's fori_loop serving step is HBM-bound at ~6 MH/s
-            # (docs/KERNELS.md): at the shared 2^28 budget its ONE
-            # timed window costs ~170 s of bench wall-clock for a
-            # diagnostic line — budget it at 2^24 (~10 s) instead
+            # the loop-form serving steps that re-stack their state
+            # every round (keccak, blake2) are HBM-bound at single-
+            # digit MH/s (docs/KERNELS.md): at the shared 2^28 budget
+            # ONE timed window costs ~170 s of bench wall-clock for a
+            # diagnostic line — budget them at 2^24 (~10 s) instead
             ks = launch_steps_for(4, chunks, 256, 1 << 24) \
-                if mname == "sha3_256" else k28
+                if mname in ("sha3_256", "blake2b_256") else k28
             try:
                 def serving_b(mname=mname, ks=ks):
                     step = cached_search_step(
@@ -482,7 +483,7 @@ def main() -> None:
               f"(at {MD5_OPS_PER_HASH} XLA-counted ops/hash)",
               file=sys.stderr)
         for tag in ("sha256", "sha1", "ripemd160", "sha512", "sha384",
-                    "sha3_256"):
+                    "sha3_256", "blake2b_256"):
             ops = get_hash_model(tag).cost_ops
             tag_rates = [v for l, v in rates.items()
                          if l.split("-")[0] == tag]

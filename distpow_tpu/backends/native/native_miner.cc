@@ -344,6 +344,7 @@ struct Md5Traits {
   static constexpr int kDigestBytes = 16;
   static constexpr bool kBigEndianLength = false;
   static constexpr bool kSpongePadding = false;
+  static constexpr bool kNeedsBlockParams = false;
   static const uint32_t* Init() { return kInitState; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressMd5(state, block);
@@ -360,6 +361,7 @@ struct Sha256Traits {
   static constexpr int kDigestBytes = 32;
   static constexpr bool kBigEndianLength = true;
   static constexpr bool kSpongePadding = false;
+  static constexpr bool kNeedsBlockParams = false;
   static const uint32_t* Init() { return kShaInit; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressSha256(state, block);
@@ -381,6 +383,7 @@ struct Sha1Traits {
   static constexpr int kDigestBytes = 20;
   static constexpr bool kBigEndianLength = true;
   static constexpr bool kSpongePadding = false;
+  static constexpr bool kNeedsBlockParams = false;
   static const uint32_t* Init() { return kSha1Init; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressSha1(state, block);
@@ -402,6 +405,7 @@ struct Ripemd160Traits {
   static constexpr int kDigestBytes = 20;
   static constexpr bool kBigEndianLength = false;  // MD5-style padding
   static constexpr bool kSpongePadding = false;
+  static constexpr bool kNeedsBlockParams = false;
   static const uint32_t* Init() { return kRmdInit; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressRipemd160(state, block);
@@ -418,6 +422,7 @@ struct Sha512Traits {
   static constexpr int kDigestBytes = 64;
   static constexpr bool kBigEndianLength = true;
   static constexpr bool kSpongePadding = false;
+  static constexpr bool kNeedsBlockParams = false;
   static const uint32_t* Init() { return kSha512Init32; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressSha512(state, block);
@@ -447,6 +452,7 @@ struct Sha384Traits {
   static constexpr int kDigestBytes = 48;  // truncated serialization
   static constexpr bool kBigEndianLength = true;
   static constexpr bool kSpongePadding = false;
+  static constexpr bool kNeedsBlockParams = false;
   static const uint32_t* Init() { return kSha384Init32; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressSha512(state, block);
@@ -534,10 +540,115 @@ struct Sha3_256Traits {
   static constexpr int kDigestBytes = 32;
   static constexpr bool kBigEndianLength = false;  // unused
   static constexpr bool kSpongePadding = true;     // pad10*1 + 0x06
+  static constexpr bool kNeedsBlockParams = false;
   static const uint32_t* Init() { return kSha3Init; }
   static void Compress(uint32_t* state, const uint8_t* block) {
     CompressSha3(state, block);
   }
+  static void StoreDigest(const uint32_t* state, uint8_t* out) {
+    std::memcpy(out, state, 32);  // LE limb serialization, lo-first
+  }
+};
+
+// --- BLAKE2b-256 (RFC 7693, round 4, eighth model) --------------------------
+// The per-block-parameter hash: compression takes a byte counter and a
+// finalization flag besides state and message.  Traits with
+// kNeedsBlockParams expose CompressWithParams and the scan loop
+// computes (t, last) per block; there are no padding marker bytes at
+// all (zero-fill only).
+
+constexpr uint64_t kBlake2bIV[8] = {
+    0x6A09E667F3BCC908ull, 0xBB67AE8584CAA73Bull, 0x3C6EF372FE94F82Bull,
+    0xA54FF53A5F1D36F1ull, 0x510E527FADE682D1ull, 0x9B05688C2B3E6C1Full,
+    0x1F83D9ABFB41BD6Bull, 0x5BE0CD19137E2179ull};
+
+constexpr uint8_t kBlake2bSigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+inline uint64_t Rotr64b(uint64_t v, int n) {
+  return (v >> n) | (v << (64 - n));
+}
+
+inline void Blake2bG(uint64_t v[16], int a, int b, int c, int d,
+                     uint64_t x, uint64_t y) {
+  v[a] = v[a] + v[b] + x;
+  v[d] = Rotr64b(v[d] ^ v[a], 32);
+  v[c] = v[c] + v[d];
+  v[b] = Rotr64b(v[b] ^ v[c], 24);
+  v[a] = v[a] + v[b] + y;
+  v[d] = Rotr64b(v[d] ^ v[a], 16);
+  v[c] = v[c] + v[d];
+  v[b] = Rotr64b(v[b] ^ v[c], 63);
+}
+
+void CompressBlake2b(uint32_t state32[16], const uint8_t block[128],
+                     uint64_t t, bool last) {
+  uint64_t h[8], m[16], v[16];
+  for (int i = 0; i < 8; ++i)
+    h[i] = static_cast<uint64_t>(state32[2 * i]) |
+           (static_cast<uint64_t>(state32[2 * i + 1]) << 32);
+  for (int i = 0; i < 16; ++i) {
+    uint64_t w = 0;
+    for (int b2 = 7; b2 >= 0; --b2) w = (w << 8) | block[8 * i + b2];
+    m[i] = w;
+  }
+  for (int i = 0; i < 8; ++i) v[i] = h[i];
+  for (int i = 0; i < 8; ++i) v[8 + i] = kBlake2bIV[i];
+  v[12] ^= t;  // t1 (v[13]) stays: real messages are < 2^64 bytes
+  if (last) v[14] = ~v[14];
+  for (int r = 0; r < 12; ++r) {
+    const uint8_t* s = kBlake2bSigma[r];
+    Blake2bG(v, 0, 4, 8, 12, m[s[0]], m[s[1]]);
+    Blake2bG(v, 1, 5, 9, 13, m[s[2]], m[s[3]]);
+    Blake2bG(v, 2, 6, 10, 14, m[s[4]], m[s[5]]);
+    Blake2bG(v, 3, 7, 11, 15, m[s[6]], m[s[7]]);
+    Blake2bG(v, 0, 5, 10, 15, m[s[8]], m[s[9]]);
+    Blake2bG(v, 1, 6, 11, 12, m[s[10]], m[s[11]]);
+    Blake2bG(v, 2, 7, 8, 13, m[s[12]], m[s[13]]);
+    Blake2bG(v, 3, 4, 9, 14, m[s[14]], m[s[15]]);
+  }
+  for (int i = 0; i < 8; ++i) {
+    const uint64_t o = h[i] ^ v[i] ^ v[i + 8];
+    state32[2 * i] = static_cast<uint32_t>(o);
+    state32[2 * i + 1] = static_cast<uint32_t>(o >> 32);
+  }
+}
+
+// h[0] ^= 0x01010000 | digest_length(32); limbs lo-first
+constexpr uint32_t kBlake2bInit32[16] = {
+    0xF2BDC928u, 0x6A09E667u, 0x84CAA73Bu, 0xBB67AE85u,
+    0xFE94F82Bu, 0x3C6EF372u, 0x5F1D36F1u, 0xA54FF53Au,
+    0xADE682D1u, 0x510E527Fu, 0x2B3E6C1Fu, 0x9B05688Cu,
+    0xFB41BD6Bu, 0x1F83D9ABu, 0x137E2179u, 0x5BE0CD19u};
+
+struct Blake2b256Traits {
+  static constexpr int kBlockBytes = 128;
+  static constexpr int kLengthBytes = 0;   // no length field
+  static constexpr int kStateWords = 16;
+  static constexpr int kDigestBytes = 32;
+  static constexpr bool kBigEndianLength = false;  // unused
+  static constexpr bool kSpongePadding = false;    // unused
+  static constexpr bool kNeedsBlockParams = true;  // zero-fill, (t, last)
+  static const uint32_t* Init() { return kBlake2bInit32; }
+  static void CompressWithParams(uint32_t* state, const uint8_t* block,
+                                 uint64_t t, bool last) {
+    CompressBlake2b(state, block, t, last);
+  }
+  // no plain Compress member: EVERY block — prefix and tail — routes
+  // through CompressWithParams (the scan loop's kNeedsBlockParams
+  // branches), and if-constexpr discards the Compress call sites for
+  // this trait at instantiation
   static void StoreDigest(const uint32_t* state, uint8_t* out) {
     std::memcpy(out, state, 32);  // LE limb serialization, lo-first
   }
@@ -595,20 +706,30 @@ void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
   std::memcpy(prefix_state, Traits::Init(), sizeof(prefix_state));
   size_t absorbed = (t.nonce_len / kBB) * kBB;
   for (size_t off = 0; off < absorbed; off += kBB) {
-    Traits::Compress(prefix_state, t.nonce + off);
+    if constexpr (Traits::kNeedsBlockParams) {
+      // never final: every candidate appends >= 1 secret byte
+      Traits::CompressWithParams(prefix_state, t.nonce + off, off + kBB,
+                                 false);
+    } else {
+      Traits::Compress(prefix_state, t.nonce + off);
+    }
   }
   const uint8_t* rem = t.nonce + absorbed;
   const size_t rem_len = t.nonce_len - absorbed;
   const size_t tail_content = rem_len + 1 + t.width;
-  // minimum padding: one byte for the sponge's merged 0x86; one byte
-  // 0x80 plus the length field for Merkle-Damgard
-  const size_t min_pad = Traits::kSpongePadding ? 1 : 1 + kLB;
+  // minimum padding: none for blake2 (zero-fill, finality is a
+  // compression PARAMETER); one byte for the sponge's merged 0x86;
+  // one byte 0x80 plus the length field for Merkle-Damgard
+  const size_t min_pad = Traits::kNeedsBlockParams
+                             ? 0 : (Traits::kSpongePadding ? 1 : 1 + kLB);
   const size_t tail_blocks = (tail_content + min_pad + kBB - 1) / kBB;
   const size_t tail_len = tail_blocks * kBB;
 
   std::memset(tail, 0, sizeof(tail));
   std::memcpy(tail, rem, rem_len);
-  if (Traits::kSpongePadding) {
+  if (Traits::kNeedsBlockParams) {
+    // no marker bytes at all; (t, last) flow into CompressWithParams
+  } else if (Traits::kSpongePadding) {
     // SHA-3 pad10*1 with the domain bits: 0x06 after the message,
     // 0x80 into the last rate byte (XORs merge them when adjacent)
     tail[tail_content] ^= 0x06;
@@ -644,7 +765,14 @@ void ScanRange(const SearchTask& t, uint64_t chunk_lo, uint64_t chunk_hi,
       uint32_t state[Traits::kStateWords];
       std::memcpy(state, prefix_state, sizeof(state));
       for (size_t b = 0; b < tail_blocks; ++b) {
-        Traits::Compress(state, tail + kBB * b);
+        if constexpr (Traits::kNeedsBlockParams) {
+          const bool last = b == tail_blocks - 1;
+          const uint64_t tb_count =
+              absorbed + (last ? tail_content : (b + 1) * kBB);
+          Traits::CompressWithParams(state, tail + kBB * b, tb_count, last);
+        } else {
+          Traits::Compress(state, tail + kBB * b);
+        }
       }
       ++hashes;
       uint8_t digest[Traits::kDigestBytes];
@@ -697,30 +825,50 @@ void DigestBuffer(const uint8_t* data, size_t len, uint8_t* out) {
   constexpr size_t kLB = Traits::kLengthBytes;
   uint32_t state[Traits::kStateWords];
   std::memcpy(state, Traits::Init(), sizeof(state));
-  size_t full = (len / kBB) * kBB;
-  for (size_t off = 0; off < full; off += kBB)
-    Traits::Compress(state, data + off);
-  uint8_t tail[2 * kBB];
-  std::memset(tail, 0, sizeof(tail));
-  size_t rem = len - full;
-  std::memcpy(tail, data + full, rem);
-  const size_t min_pad = Traits::kSpongePadding ? 1 : 1 + kLB;
-  size_t tail_len = rem + min_pad <= kBB ? kBB : 2 * kBB;
-  if (Traits::kSpongePadding) {
-    tail[rem] ^= 0x06;
-    tail[tail_len - 1] ^= 0x80;
+  if constexpr (Traits::kNeedsBlockParams) {
+    // blake2: only blocks with KNOWN following data are non-final —
+    // a message that is an exact block multiple ends with a FULL
+    // final block (last=true), unlike the search tail
+    const size_t n_nonfinal = len ? (len - 1) / kBB : 0;
+    for (size_t b = 0; b < n_nonfinal; ++b)
+      Traits::CompressWithParams(state, data + b * kBB, (b + 1) * kBB,
+                                 false);
+    uint8_t tail[kBB];
+    std::memset(tail, 0, sizeof(tail));
+    const size_t rem = len - n_nonfinal * kBB;
+    std::memcpy(tail, data + n_nonfinal * kBB, rem);
+    Traits::CompressWithParams(state, tail, len, true);
+    Traits::StoreDigest(state, out);
   } else {
-    tail[rem] = 0x80;
-    uint64_t bits = static_cast<uint64_t>(len) * 8;
-    for (size_t i = 0; i < kLB; ++i) {
-      const size_t shift = Traits::kBigEndianLength
-                               ? 8 * (kLB - 1 - i) : 8 * i;
-      tail[tail_len - kLB + i] =
-          shift < 64 ? static_cast<uint8_t>(bits >> shift) : 0;
+    // an if-constexpr early return would NOT discard this branch for
+    // the params traits — only a real else does, and the params traits
+    // have no plain Compress member
+    size_t full = (len / kBB) * kBB;
+    for (size_t off = 0; off < full; off += kBB)
+      Traits::Compress(state, data + off);
+    uint8_t tail[2 * kBB];
+    std::memset(tail, 0, sizeof(tail));
+    size_t rem = len - full;
+    std::memcpy(tail, data + full, rem);
+    const size_t min_pad = Traits::kSpongePadding ? 1 : 1 + kLB;
+    size_t tail_len = rem + min_pad <= kBB ? kBB : 2 * kBB;
+    if (Traits::kSpongePadding) {
+      tail[rem] ^= 0x06;
+      tail[tail_len - 1] ^= 0x80;
+    } else {
+      tail[rem] = 0x80;
+      uint64_t bits = static_cast<uint64_t>(len) * 8;
+      for (size_t i = 0; i < kLB; ++i) {
+        const size_t shift = Traits::kBigEndianLength
+                                 ? 8 * (kLB - 1 - i) : 8 * i;
+        tail[tail_len - kLB + i] =
+            shift < 64 ? static_cast<uint8_t>(bits >> shift) : 0;
+      }
     }
+    for (size_t b = 0; b < tail_len; b += kBB)
+      Traits::Compress(state, tail + b);
+    Traits::StoreDigest(state, out);
   }
-  for (size_t b = 0; b < tail_len; b += kBB) Traits::Compress(state, tail + b);
-  Traits::StoreDigest(state, out);
 }
 
 }  // namespace
@@ -742,7 +890,7 @@ extern "C" {
 //
 // `algo`: 0 = MD5 (reference parity), 1 = SHA-256 (the north-star hash
 // option), 2 = SHA-1, 3 = RIPEMD-160, 4 = SHA-512, 5 = SHA-384,
-// 6 = SHA3-256; -2 on any other value.
+// 6 = SHA3-256, 7 = BLAKE2b-256; -2 on any other value.
 int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint32_t difficulty, uint32_t algo,
                          const uint8_t* thread_bytes,
@@ -750,7 +898,7 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint64_t chunk_count, int32_t n_threads,
                          const volatile int32_t* cancel_flag,
                          uint64_t* out_hashes, uint8_t* out_secret) {
-  if (n_tb == 0 || width > 8 || algo > 6) return -2;
+  if (n_tb == 0 || width > 8 || algo > 7) return -2;
   // a difficulty beyond the digest's nibble count would read past the
   // digest buffer in MeetsDifficulty (and the puzzle is unsatisfiable
   // anyway — the JAX paths reject it in nibble_masks)
@@ -761,7 +909,8 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
            : algo == 3 ? Ripemd160Traits::kDigestBytes
            : algo == 4 ? Sha512Traits::kDigestBytes
            : algo == 5 ? Sha384Traits::kDigestBytes
-                       : Sha3_256Traits::kDigestBytes);
+           : algo == 6 ? Sha3_256Traits::kDigestBytes
+                       : Blake2b256Traits::kDigestBytes);
   if (difficulty > max_nibbles) return -2;
   SearchTask task{nonce,        nonce_len,  difficulty,
                   thread_bytes, n_tb,       width,
@@ -782,9 +931,12 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
     SearchRange<Sha512Traits>(task, chunk_count, n_threads, &found, &hashes);
   } else if (algo == 5) {
     SearchRange<Sha384Traits>(task, chunk_count, n_threads, &found, &hashes);
-  } else {
+  } else if (algo == 6) {
     SearchRange<Sha3_256Traits>(task, chunk_count, n_threads, &found,
                                 &hashes);
+  } else {
+    SearchRange<Blake2b256Traits>(task, chunk_count, n_threads, &found,
+                                  &hashes);
   }
 
   if (out_hashes) *out_hashes = hashes;
@@ -828,6 +980,10 @@ void distpow_sha384(const uint8_t* data, size_t len, uint8_t out[48]) {
 
 void distpow_sha3_256(const uint8_t* data, size_t len, uint8_t out[32]) {
   DigestBuffer<Sha3_256Traits>(data, len, out);
+}
+
+void distpow_blake2b_256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  DigestBuffer<Blake2b256Traits>(data, len, out);
 }
 
 }  // extern "C"

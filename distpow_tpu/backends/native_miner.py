@@ -59,7 +59,7 @@ def load_library(build: bool = True) -> ctypes.CDLL:
             ctypes.c_uint32,                            # difficulty
             ctypes.c_uint32,   # algo: 0 md5, 1 sha256, 2 sha1,
                                # 3 ripemd160, 4 sha512, 5 sha384,
-                               # 6 sha3_256
+                               # 6 sha3_256, 7 blake2b_256
             ctypes.c_char_p, ctypes.c_size_t,          # thread bytes
             ctypes.c_uint32,                            # width
             ctypes.c_uint64, ctypes.c_uint64,          # chunk start/count
@@ -96,12 +96,16 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_sha3_256.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.distpow_blake2b_256.restype = None
+        lib.distpow_blake2b_256.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
         _lib = lib
         return lib
 
 
 ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2, "ripemd160": 3,
-            "sha512": 4, "sha384": 5, "sha3_256": 6}
+            "sha512": 4, "sha384": 5, "sha3_256": 6, "blake2b_256": 7}
 
 # Digest sizes (bytes) for the native algorithms, fixed by RFC 1321 /
 # FIPS 180-4.  max difficulty = hex nibbles = 2 * digest bytes; kept
@@ -109,7 +113,8 @@ ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2, "ripemd160": 3,
 # path never imports the JAX model modules (advisor r3: resolving
 # max_difficulty via models.registry pulled jax into native-only use).
 DIGEST_BYTES = {"md5": 16, "sha256": 32, "sha1": 20, "ripemd160": 20,
-                "sha512": 64, "sha384": 48, "sha3_256": 32}
+                "sha512": 64, "sha384": 48, "sha3_256": 32,
+                "blake2b_256": 32}
 
 
 def native_md5(data: bytes) -> bytes:
@@ -158,6 +163,13 @@ def native_sha3_256(data: bytes) -> bytes:
     lib = load_library()
     out = ctypes.create_string_buffer(32)
     lib.distpow_sha3_256(data, len(data), out)
+    return out.raw
+
+
+def native_blake2b_256(data: bytes) -> bytes:
+    lib = load_library()
+    out = ctypes.create_string_buffer(32)
+    lib.distpow_blake2b_256(data, len(data), out)
     return out.raw
 
 

@@ -1,0 +1,159 @@
+"""BLAKE2b-256 as a vectorized JAX computation over uint32 limb pairs.
+
+Eighth registry model: the per-block-parameter proof.  The compression
+consumes 36 template words — 32 message limbs (16 little-endian 64-bit
+words, lo limb first, exactly the packing serialization) plus the 4
+parameter limbs the packing layer bakes per block
+(``HashModel.block_param_words``): t_lo, t_hi (byte counter through
+this block) and f_lo, f_hi (the finalization word, all-ones on the
+last block).  For a fixed search layout these are compile-time
+constants, which is what lets blake2's (state, message, t, f)
+signature ride the framework's ``compress(state, words)`` shape
+without changing any hash-agnostic layer.
+
+Form: ``lax.fori_loop`` over the 12 rounds; the per-round message
+schedule is a gather through the (12, 16) SIGMA table, and the carry
+is the 16-lane working vector v stacked into ONE (32, batch) array
+(the sha1/keccak shard_map carry lesson).  No unrolled XLA form —
+the limb-graph compile pathology is established
+(docs/artifacts/r4c/sha512_forms.json); the Pallas tile is the TPU
+serving path.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .blake2b_py import (  # noqa: F401  (shared spec data + py twin)
+    BLAKE2B_INIT,
+    BLAKE2B_INIT64,
+    BLAKE2B_IV,
+    BLAKE2B_SIGMA,
+    BLOCK_BYTES,
+    DIGEST_WORDS,
+    LENGTH_BYTEORDER,
+    PARAM_WORDS,
+    ROUNDS,
+    STATE_WORDS,
+    WORD_BYTEORDER,
+    block_param_words,
+    py_absorb,
+    py_compress,
+    py_digest,
+)
+from .sha512_jax import _u
+
+U32 = jnp.uint32
+
+_IV_LIMBS = tuple(
+    w for v in BLAKE2B_IV for w in (v & 0xFFFFFFFF, (v >> 32) & 0xFFFFFFFF)
+)
+
+
+def _rotr64_lohi(lo, hi, n: int):
+    """rotr of a (lo, hi) pair by a static amount."""
+    n %= 64
+    if n == 0:
+        return lo, hi
+    if n == 32:
+        return hi, lo
+    if n > 32:
+        lo, hi, n = hi, lo, n - 32
+    return (
+        (lo >> n) | (hi << (32 - n)),
+        (hi >> n) | (lo << (32 - n)),
+    )
+
+
+def _add64(alo, ahi, blo, bhi):
+    lo = alo + blo
+    carry = (lo < alo).astype(U32)
+    return lo, ahi + bhi + carry
+
+
+@jax.jit
+def _blake2b_compress_jit(state, words):
+    # one common shape up front: the fori carry must be shape-invariant
+    # and limbs mix scalars (state, params) with batch message words
+    all_limbs = jnp.broadcast_arrays(*(_u(x) for x in (
+        tuple(state) + tuple(words))))
+    h = all_limbs[:STATE_WORDS]
+    m = all_limbs[STATE_WORDS: STATE_WORDS + 32]
+    t_lo, t_hi, f_lo, f_hi = all_limbs[STATE_WORDS + 32:]
+
+    m_lo = jnp.stack([m[2 * i] for i in range(16)])
+    m_hi = jnp.stack([m[2 * i + 1] for i in range(16)])
+
+    v = []
+    for i in range(8):
+        v.append((h[2 * i], h[2 * i + 1]))
+    for i in range(8):
+        iv_lo = jnp.broadcast_to(U32(_IV_LIMBS[2 * i]), h[0].shape)
+        iv_hi = jnp.broadcast_to(U32(_IV_LIMBS[2 * i + 1]), h[0].shape)
+        if i == 4:  # v[12] ^= t (t1 is always 0: real message sizes)
+            iv_lo, iv_hi = iv_lo ^ t_lo, iv_hi ^ t_hi
+        if i == 6:  # v[14] ^= f0
+            iv_lo, iv_hi = iv_lo ^ f_lo, iv_hi ^ f_hi
+        v.append((iv_lo, iv_hi))
+
+    sigma = jnp.asarray(BLAKE2B_SIGMA, jnp.int32)  # (12, 16)
+
+    # one G mixing function on the stacked carry, static lane indices,
+    # dynamically gathered message words
+    def g(st, a, b, c, d, xlo, xhi, ylo, yhi):
+        alo, ahi = st[2 * a], st[2 * a + 1]
+        blo, bhi = st[2 * b], st[2 * b + 1]
+        clo, chi = st[2 * c], st[2 * c + 1]
+        dlo, dhi = st[2 * d], st[2 * d + 1]
+        alo, ahi = _add64(*_add64(alo, ahi, blo, bhi), xlo, xhi)
+        dlo, dhi = _rotr64_lohi(dlo ^ alo, dhi ^ ahi, 32)
+        clo, chi = _add64(clo, chi, dlo, dhi)
+        blo, bhi = _rotr64_lohi(blo ^ clo, bhi ^ chi, 24)
+        alo, ahi = _add64(*_add64(alo, ahi, blo, bhi), ylo, yhi)
+        dlo, dhi = _rotr64_lohi(dlo ^ alo, dhi ^ ahi, 16)
+        clo, chi = _add64(clo, chi, dlo, dhi)
+        blo, bhi = _rotr64_lohi(blo ^ clo, bhi ^ chi, 63)
+        for idx, val in ((2 * a, alo), (2 * a + 1, ahi), (2 * b, blo),
+                         (2 * b + 1, bhi), (2 * c, clo), (2 * c + 1, chi),
+                         (2 * d, dlo), (2 * d + 1, dhi)):
+            st[idx] = val
+        return st
+
+    LANES_G = ((0, 4, 8, 12), (1, 5, 9, 13), (2, 6, 10, 14),
+               (3, 7, 11, 15), (0, 5, 10, 15), (1, 6, 11, 12),
+               (2, 7, 8, 13), (3, 4, 9, 14))
+
+    def round_body(r, stacked):
+        st = [stacked[i] for i in range(32)]
+        s = sigma[r]
+        for gi, (a, b, c, d) in enumerate(LANES_G):
+            xi, yi = s[2 * gi], s[2 * gi + 1]
+            st = g(st, a, b, c, d,
+                   m_lo[xi], m_hi[xi], m_lo[yi], m_hi[yi])
+        return jnp.stack(st)
+
+    st0 = jnp.stack([limb for pair in v for limb in pair])
+    out = lax.fori_loop(0, ROUNDS, round_body, st0)
+
+    res = []
+    for i in range(8):
+        res.append(h[2 * i] ^ out[2 * i] ^ out[2 * (i + 8)])
+        res.append(h[2 * i + 1] ^ out[2 * i + 1] ^ out[2 * (i + 8) + 1])
+    return tuple(res)
+
+
+def blake2b_256_compress(state, words: Sequence):
+    """One BLAKE2b compression, vectorized.
+
+    ``state`` is 16 uint32 limbs (8 lanes lo-first); ``words`` is 36
+    broadcast-compatible uint32 entries — 32 message limbs + the 4
+    baked parameter limbs (module docstring).  Eager calls route
+    through a module-level jit; under an outer jit it inlines.
+    """
+    return _blake2b_compress_jit(
+        tuple(_u(x) for x in state), tuple(_u(x) for x in words)
+    )

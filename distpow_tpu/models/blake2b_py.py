@@ -1,0 +1,199 @@
+"""BLAKE2b-256 spec data + pure-Python twin (jax-free).
+
+Eighth registry model (round 4) and the per-block-parameter proof: a
+BLAKE2b compression takes, besides the state and message words, a byte
+COUNTER ``t`` (total message bytes absorbed through this block) and a
+FINALIZATION flag ``f0`` — inputs that are neither state nor message.
+The first seven models never exercised that shape; here the packing
+layer bakes them per block into extra constant template words
+(``HashModel.block_param_words``, ops/packing.py) since for a fixed
+search layout they are compile-time constants.
+
+RFC 7693 parameters for BLAKE2b-256 (sequential mode, no key): 128-byte
+blocks, 12 rounds, digest 32 bytes = the first 4 of 8 64-bit state
+words, everything little-endian.  There is NO padding marker: the final
+block is zero-filled and distinguished solely by ``f0`` and ``t`` —
+``padding="blake2"`` writes nothing at all.
+
+The framework carries the 8-lane state as 16 uint32 limbs lo-first
+(little-endian serialization order, like sha3), so the digest is the
+leading 8 uint32 words with ``word_byteorder="little"``.
+
+Oracle: hashlib.blake2b(digest_size=32) (guaranteed in CPython).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+BLOCK_BYTES = 128
+DIGEST_WORDS = 8            # 32-byte digest as uint32 words
+WORD_BYTEORDER = "little"
+LENGTH_BYTEORDER = "little"  # unused (no length field in the padding)
+STATE_WORDS = 16            # 8 lanes x 2 uint32 limbs, lo-first
+ROUNDS = 12
+# extra per-block template words appended by the packing layer:
+# t_lo, t_hi (the 64-bit byte counter; t1 is always 0 for real message
+# sizes), f_lo, f_hi (the finalization word: all-ones on the last
+# block, else 0)
+PARAM_WORDS = 4
+
+MASK64 = (1 << 64) - 1
+
+BLAKE2B_IV: Tuple[int, ...] = (
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B,
+    0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+)
+
+# h[0] ^= 0x01010000 | digest_length  (fanout 1, depth 1, no key)
+_PARAM_XOR = 0x01010000 | 32
+
+BLAKE2B_INIT64: Tuple[int, ...] = (
+    (BLAKE2B_IV[0] ^ _PARAM_XOR),
+) + BLAKE2B_IV[1:]
+
+# lo-first uint32 limb serialization of the init state
+BLAKE2B_INIT: Tuple[int, ...] = tuple(
+    w for v in BLAKE2B_INIT64 for w in (v & 0xFFFFFFFF, (v >> 32) & 0xFFFFFFFF)
+)
+
+# message schedule permutations (RFC 7693 table; rounds 10, 11 reuse
+# rows 0, 1)
+BLAKE2B_SIGMA: Tuple[Tuple[int, ...], ...] = (
+    (0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
+    (14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3),
+    (11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4),
+    (7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8),
+    (9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13),
+    (2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9),
+    (12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11),
+    (13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10),
+    (6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5),
+    (10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0),
+    (0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
+    (14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3),
+)
+
+
+def _rotr64(v: int, n: int) -> int:
+    return ((v >> n) | (v << (64 - n))) & MASK64
+
+
+def _g(v: List[int], a: int, b: int, c: int, d: int, x: int, y: int) -> None:
+    v[a] = (v[a] + v[b] + x) & MASK64
+    v[d] = _rotr64(v[d] ^ v[a], 32)
+    v[c] = (v[c] + v[d]) & MASK64
+    v[b] = _rotr64(v[b] ^ v[c], 24)
+    v[a] = (v[a] + v[b] + y) & MASK64
+    v[d] = _rotr64(v[d] ^ v[a], 16)
+    v[c] = (v[c] + v[d]) & MASK64
+    v[b] = _rotr64(v[b] ^ v[c], 63)
+
+
+def blake2b_f(h: List[int], m: List[int], t: int, last: bool) -> List[int]:
+    """One BLAKE2b compression: 8 uint64 state words, 16 message words,
+    byte counter ``t``, finalization flag ``last``."""
+    v = list(h) + list(BLAKE2B_IV)
+    v[12] ^= t & MASK64
+    v[13] ^= (t >> 64) & MASK64  # t1: always 0 for real message sizes
+    if last:
+        v[14] ^= MASK64
+    for r in range(ROUNDS):
+        s = BLAKE2B_SIGMA[r]
+        _g(v, 0, 4, 8, 12, m[s[0]], m[s[1]])
+        _g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
+        _g(v, 2, 6, 10, 14, m[s[4]], m[s[5]])
+        _g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
+        _g(v, 0, 5, 10, 15, m[s[8]], m[s[9]])
+        _g(v, 1, 6, 11, 12, m[s[10]], m[s[11]])
+        _g(v, 2, 7, 8, 13, m[s[12]], m[s[13]])
+        _g(v, 3, 4, 9, 14, m[s[14]], m[s[15]])
+    return [h[i] ^ v[i] ^ v[i + 8] for i in range(8)]
+
+
+def _limbs_to_lanes(state, n: int) -> List[int]:
+    return [int(state[2 * i]) | (int(state[2 * i + 1]) << 32)
+            for i in range(n)]
+
+
+def _lanes_to_limbs(lanes) -> Tuple[int, ...]:
+    out: List[int] = []
+    for v in lanes:
+        out.append(v & 0xFFFFFFFF)
+        out.append((v >> 32) & 0xFFFFFFFF)
+    return tuple(out)
+
+
+def py_compress(state: Tuple[int, ...], block: bytes) -> Tuple[int, ...]:
+    """Absorb one rate block whose trailing PARAM_WORDS*4 bytes carry
+    the baked (t, f) parameter words — the packing-template form.
+
+    The generic host-absorption path never calls this for blake2
+    (py_absorb below owns prefix blocks with explicit parameters); this
+    entry exists for template-shaped blocks of
+    ``BLOCK_BYTES + 4 * PARAM_WORDS`` bytes.
+    """
+    assert len(block) == BLOCK_BYTES + 4 * PARAM_WORDS
+    h = _limbs_to_lanes(state, 8)
+    m = [int.from_bytes(block[8 * i: 8 * i + 8], "little") for i in range(16)]
+    t = int.from_bytes(block[128:136], "little")
+    f = int.from_bytes(block[136:144], "little")
+    return _lanes_to_limbs(blake2b_f(h, m, t, f != 0))
+
+
+def py_absorb(prefix: bytes) -> Tuple[Tuple[int, ...], bytes, int]:
+    """Absorb the full 128-byte blocks of ``prefix`` that are safely
+    non-final.  A block is only compressible once later data is KNOWN
+    to exist; every search candidate appends >= 1 secret byte after the
+    nonce, so all full prefix blocks qualify (t = bytes so far,
+    last = False)."""
+    state64 = list(BLAKE2B_INIT64)
+    n_full = len(prefix) // BLOCK_BYTES
+    for b in range(n_full):
+        block = prefix[b * BLOCK_BYTES: (b + 1) * BLOCK_BYTES]
+        m = [int.from_bytes(block[8 * i: 8 * i + 8], "little")
+             for i in range(16)]
+        state64 = blake2b_f(state64, m, (b + 1) * BLOCK_BYTES, False)
+    absorbed = n_full * BLOCK_BYTES
+    return _lanes_to_limbs(state64), prefix[absorbed:], absorbed
+
+
+def block_param_words(absorbed: int, content: int, block_idx: int,
+                      n_blocks: int) -> Tuple[int, int, int, int]:
+    """The per-block parameter limbs the packing layer bakes into the
+    template (``HashModel.block_param_words``): byte counter t through
+    this block's MESSAGE bytes (zero-fill padding is not counted, so
+    the final block uses the true message length), and the
+    finalization word f0 (all-ones on the last block).  The search
+    tail always contains the message end, so finality is static."""
+    last = block_idx == n_blocks - 1
+    t = absorbed + (content if last else (block_idx + 1) * BLOCK_BYTES)
+    f = 0xFFFFFFFF if last else 0
+    return (t & 0xFFFFFFFF, (t >> 32) & 0xFFFFFFFF, f, f)
+
+
+def py_digest(message: bytes) -> bytes:
+    """BLAKE2b-256 from the twin (oracle parity with hashlib.blake2b).
+
+    Unlike ``py_absorb`` (whose callers always append more bytes), the
+    whole message is in hand here, so the final block — even a FULL one
+    when ``len % 128 == 0`` — must be compressed with ``last=True``:
+    blake2 buffers one block precisely because finality is only known
+    once the stream ends.
+    """
+    n_full_nonfinal = max(0, (len(message) - 1) // BLOCK_BYTES)
+    h = list(BLAKE2B_INIT64)
+    for b in range(n_full_nonfinal):
+        block = message[b * BLOCK_BYTES: (b + 1) * BLOCK_BYTES]
+        m = [int.from_bytes(block[8 * i: 8 * i + 8], "little")
+             for i in range(16)]
+        h = blake2b_f(h, m, (b + 1) * BLOCK_BYTES, False)
+    rem = message[n_full_nonfinal * BLOCK_BYTES:]
+    tail = bytearray(BLOCK_BYTES)
+    tail[: len(rem)] = rem
+    m = [int.from_bytes(bytes(tail[8 * i: 8 * i + 8]), "little")
+         for i in range(16)]
+    h = blake2b_f(h, m, len(message), True)
+    return b"".join(int(w).to_bytes(8, "little") for w in h[:4])

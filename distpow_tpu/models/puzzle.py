@@ -49,6 +49,11 @@ def new_hash(algo: str):
     puzzle hashing goes through here so the fallback cannot be
     bypassed.
     """
+    if algo == "blake2b_256":
+        # a PARAMETERIZED hashlib constructor, not a named algorithm:
+        # blake2b's digest size is a compression input (it XORs into
+        # h[0]), so ``hashlib.new`` has no name for this variant
+        return hashlib.blake2b(digest_size=32)
     try:
         return hashlib.new(algo)
     except ValueError:

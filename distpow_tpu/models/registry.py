@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Sequence, Tuple
 
 from . import (
+    blake2b_jax,
     md5_jax,
     ripemd160_jax,
     sha1_jax,
@@ -54,8 +55,20 @@ class HashModel:
     #          original models);
     # "sha3" — the sponge's pad10*1 with the SHA-3 domain bits: 0x06
     #          after the message, 0x80 into the LAST rate byte (the two
-    #          merge to 0x86 when adjacent), no length field.
+    #          merge to 0x86 when adjacent), no length field;
+    # "blake2" — nothing at all: the final block is zero-filled and
+    #          distinguished solely by the baked parameter words below.
     padding: str = "md"
+    # Per-block compression PARAMETERS (beyond state and message):
+    # blake2's byte counter and finalization flag.  For a fixed search
+    # layout they are compile-time constants, so the packing layer
+    # appends ``param_words`` extra uint32 template words to each
+    # block's row, produced by ``block_param_words(absorbed_bytes,
+    # tail_msg_len, block_idx, n_blocks)``; ``compress`` slices them
+    # off the end of its words.  0/None for every hash whose
+    # compression is purely (state, message).
+    param_words: int = 0
+    block_param_words: Callable = None
 
     @property
     def digest_bytes(self) -> int:
@@ -178,9 +191,28 @@ SHA3_256 = HashModel(
     cost_ops=9900,
 )
 
+BLAKE2B_256 = HashModel(
+    name="blake2b_256",
+    block_bytes=blake2b_jax.BLOCK_BYTES,
+    digest_words=blake2b_jax.DIGEST_WORDS,  # 8 of the 16 carried limbs
+    word_byteorder=blake2b_jax.WORD_BYTEORDER,
+    length_byteorder=blake2b_jax.LENGTH_BYTEORDER,  # unused (no field)
+    init_state=blake2b_jax.BLAKE2B_INIT,
+    compress=blake2b_jax.blake2b_256_compress,
+    py_compress=blake2b_jax.py_compress,
+    py_absorb=blake2b_jax.py_absorb,
+    padding="blake2",                       # zero-fill, no markers
+    param_words=blake2b_jax.PARAM_WORDS,    # t (2 limbs) + f0 (2 limbs)
+    block_param_words=blake2b_jax.block_param_words,
+    # cost_analysis of the unrolled tile at the serving mask bucket
+    # (same convention as sha3_256 — no unrolled XLA serving form)
+    cost_ops=5205,
+)
+
 _REGISTRY: Dict[str, HashModel] = {
     "md5": MD5, "sha256": SHA256, "sha1": SHA1, "ripemd160": RIPEMD160,
     "sha512": SHA512, "sha384": SHA384, "sha3_256": SHA3_256,
+    "blake2b_256": BLAKE2B_256,
 }
 
 

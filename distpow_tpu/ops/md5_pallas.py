@@ -118,7 +118,13 @@ MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (32, 256),
                   # docs/artifacts/r4c/); BELOW a vreg's 8-sublane
                   # height the lanes go half-used — sublanes=4 measured
                   # 285, sublanes=2 144 (r4 probe)
-                  "sha3_256": (8, 2048)}
+                  "sha3_256": (8, 2048),
+                  # blake2b (32, 128) measured 974.9 MH/s = 61x the XLA
+                  # loop step's 16.0 (r4c sweep; the absolute best
+                  # (24, 1024) at 977.4 is again not pow2-compatible).
+                  # Unlike keccak it prefers TALLER tiles — the v
+                  # working set is half the sponge state's
+                  "blake2b_256": (32, 128)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 
 # Models whose tile only serves on REAL TPU hardware: interpret mode
@@ -127,7 +133,8 @@ _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 # vs seconds for everything else).  build_pallas_search_step raises
 # ValueError for these under interpret=True and callers fall back to
 # the fused XLA step, exactly like a model with no tile at all.
-INTERPRET_XLA_FALLBACK = frozenset({"sha512", "sha384", "sha3_256"})
+INTERPRET_XLA_FALLBACK = frozenset(
+    {"sha512", "sha384", "sha3_256", "blake2b_256"})
 
 
 def default_geometry(model_name: str, interpret: bool = False):
@@ -571,6 +578,72 @@ def _sha384_tile(words, init, mask_words: int = 12):
     return _sha512_tile_impl(words, init, mask_words, 12)
 
 
+def _blake2b_tile(words, init, mask_words: int = 8):
+    """Unrolled BLAKE2b-256 on a tile, in (lo, hi) uint32 limb pairs.
+
+    ``words`` is 36 entries — 32 message limbs + the 4 baked parameter
+    limbs (byte counter t, finalization word f0) the packing layer
+    appends per block (``HashModel.block_param_words``); ``init`` is 16
+    limbs (8 lanes lo-first).  12 rounds of 8 G mixes with the static
+    SIGMA schedule; the limb helpers are shared with the fori_loop
+    compress (models/blake2b_jax.py).  Like keccak, every round mixes
+    every lane, so the only DCE is the FINAL round's diagonal G calls
+    pruned to those writing a v-lane a live digest word reads (the
+    dominant ≤8-nibble bucket keeps lane 3: v[3] via G(3,4,9,14) and
+    v[11] via G(1,6,11,12) — 2 of 4 diagonals skipped).  Returns 8
+    entries, ``None`` where dead.
+    """
+    from ..models.blake2b_jax import _add64, _rotr64_lohi
+    from ..models.blake2b_py import BLAKE2B_IV, BLAKE2B_SIGMA, ROUNDS
+
+    mw = max(1, min(8, mask_words))
+    need_lanes = sorted({w // 2 for w in range(8 - mw, 8)})
+
+    v = [(init[2 * i], init[2 * i + 1]) for i in range(8)]
+    m = [(words[2 * i], words[2 * i + 1]) for i in range(16)]
+    for i in range(8):
+        v.append((jnp.uint32(BLAKE2B_IV[i] & 0xFFFFFFFF),
+                  jnp.uint32((BLAKE2B_IV[i] >> 32) & 0xFFFFFFFF)))
+    v[12] = (v[12][0] ^ words[32], v[12][1] ^ words[33])
+    v[14] = (v[14][0] ^ words[34], v[14][1] ^ words[35])
+
+    def G(a, b, c, d, x, y):
+        alo, ahi = v[a]
+        blo, bhi = v[b]
+        clo, chi = v[c]
+        dlo, dhi = v[d]
+        alo, ahi = _add64(*_add64(alo, ahi, blo, bhi), x[0], x[1])
+        dlo, dhi = _rotr64_lohi(dlo ^ alo, dhi ^ ahi, 32)
+        clo, chi = _add64(clo, chi, dlo, dhi)
+        blo, bhi = _rotr64_lohi(blo ^ clo, bhi ^ chi, 24)
+        alo, ahi = _add64(*_add64(alo, ahi, blo, bhi), y[0], y[1])
+        dlo, dhi = _rotr64_lohi(dlo ^ alo, dhi ^ ahi, 16)
+        clo, chi = _add64(clo, chi, dlo, dhi)
+        blo, bhi = _rotr64_lohi(blo ^ clo, bhi ^ chi, 63)
+        v[a], v[b], v[c], v[d] = (alo, ahi), (blo, bhi), (clo, chi), \
+            (dlo, dhi)
+
+    COLS = ((0, 4, 8, 12), (1, 5, 9, 13), (2, 6, 10, 14), (3, 7, 11, 15))
+    DIAGS = ((0, 5, 10, 15), (1, 6, 11, 12), (2, 7, 8, 13), (3, 4, 9, 14))
+    for r in range(ROUNDS):
+        s = BLAKE2B_SIGMA[r]
+        for gi, (a, b, c, d) in enumerate(COLS):
+            G(a, b, c, d, m[s[2 * gi]], m[s[2 * gi + 1]])
+        for gi, (a, b, c, d) in enumerate(DIAGS):
+            if r == ROUNDS - 1:
+                writes = {a, b, c, d}
+                if not any(j in writes or j + 8 in writes
+                           for j in need_lanes):
+                    continue
+            G(a, b, c, d, m[s[8 + 2 * gi]], m[s[8 + 2 * gi + 1]])
+
+    out = [None] * 8
+    for w in range(8 - mw, 8):
+        j, limb = w // 2, w % 2
+        out[w] = init[2 * j + limb] ^ v[j][limb] ^ v[j + 8][limb]
+    return tuple(out)
+
+
 # model -> (tile fn, init-state words, digest words, block words); a
 # model has a kernel iff it has an entry here, and MODEL_GEOMETRY above
 # is checked against this at import so the two can't drift apart.
@@ -579,7 +652,9 @@ _TILE_FNS = {"md5": (_md5_tile, 4, 4, 16), "sha256": (_sha256_tile, 8, 8, 16),
              "ripemd160": (_ripemd160_tile, 5, 5, 16),
              "sha512": (_sha512_tile, 16, 16, 32),
              "sha384": (_sha384_tile, 16, 12, 32),
-             "sha3_256": (_sha3_tile, 50, 8, 34)}
+             "sha3_256": (_sha3_tile, 50, 8, 34),
+             # 36 = 32 message limbs + 4 baked parameter limbs
+             "blake2b_256": (_blake2b_tile, 16, 8, 36)}
 assert set(_TILE_FNS) == set(MODEL_GEOMETRY), \
     "every pallas kernel model needs a MODEL_GEOMETRY entry and vice versa"
 

@@ -46,7 +46,9 @@ class TailSpec:
     width: int                      # chunk byte width (0 => no chunk bytes)
     init_state: Tuple[int, ...]     # state after absorbing full nonce blocks
     n_blocks: int                   # tail blocks to compress on device (1-2)
-    base_words: Tuple[Tuple[int, ...], ...]  # [n_blocks][16] constant words
+    # [n_blocks][words_per_block + param_words] constant words (blake2's
+    # baked per-block t/f parameter limbs ride at the end of each row)
+    base_words: Tuple[Tuple[int, ...], ...]
     tb_loc: ByteLoc                 # where the thread byte lands
     chunk_locs: Tuple[ByteLoc, ...]  # where chunk byte j (LE) lands, j < width
 
@@ -87,7 +89,12 @@ def build_tail_spec(
     # where 0x06 and the final 0x80 merge to one 0x86 byte when
     # adjacent, and there is no length field.
     content = len(rem) + 1 + width + len(extra_const_chunk)
-    min_pad = 1 if model.padding == "sha3" else 1 + model.length_bytes
+    if model.padding == "blake2":
+        min_pad = 0  # zero-fill only; finality lives in the params
+    elif model.padding == "sha3":
+        min_pad = 1
+    else:
+        min_pad = 1 + model.length_bytes
     n_blocks = (content + min_pad + model.block_bytes - 1) \
         // model.block_bytes
     tail = bytearray(n_blocks * model.block_bytes)
@@ -97,7 +104,9 @@ def build_tail_spec(
     chunk_pos0 = tb_pos + 1
     extra_pos = chunk_pos0 + width
     tail[extra_pos : extra_pos + len(extra_const_chunk)] = extra_const_chunk
-    if model.padding == "sha3":
+    if model.padding == "blake2":
+        pass  # no marker bytes; the param words carry t and f0
+    elif model.padding == "sha3":
         tail[extra_pos + len(extra_const_chunk)] ^= 0x06
         tail[-1] ^= 0x80
     else:
@@ -110,15 +119,21 @@ def build_tail_spec(
             model.length_bytes, model.length_byteorder)
 
     fmt_order = model.word_byteorder
+    absorbed = len(nonce) - len(rem)
     base_words: List[Tuple[int, ...]] = []
     for b in range(n_blocks):
         blk = tail[b * model.block_bytes : (b + 1) * model.block_bytes]
-        base_words.append(
-            tuple(
-                int.from_bytes(blk[4 * w : 4 * w + 4], fmt_order)
-                for w in range(model.words_per_block)
-            )
+        row = tuple(
+            int.from_bytes(blk[4 * w : 4 * w + 4], fmt_order)
+            for w in range(model.words_per_block)
         )
+        if model.block_param_words is not None:
+            # per-block compression parameters (blake2's byte counter +
+            # finalization flag) baked as extra constant template words
+            extra = model.block_param_words(absorbed, content, b, n_blocks)
+            assert len(extra) == model.param_words, (len(extra), model.name)
+            row += tuple(extra)
+        base_words.append(row)
 
     return TailSpec(
         model_name=model.name,
@@ -136,7 +151,8 @@ def make_words(spec: TailSpec, tb, chunk) -> List[List]:
     """Materialize the tail block word lists for a batch of candidates.
 
     ``tb`` and ``chunk`` are broadcast-compatible uint32 arrays (or ints).
-    Returns ``spec.n_blocks`` lists of 16 entries, each an int (constant
+    Returns ``spec.n_blocks`` lists of ``len(base_words[0])`` entries
+    (words_per_block, plus any baked param words), each an int (constant
     word) or an array (word containing variable bytes).
     """
     tb = jnp.asarray(tb, jnp.uint32)

@@ -133,7 +133,9 @@ def eval_dyn_candidates(model, n_blocks, tb_loc, chunk_locs, init, base, tb, chu
     """
     state = tuple(init[i] for i in range(len(model.init_state)))
     for b in range(n_blocks):
-        words = [base[b, w] for w in range(model.words_per_block)]
+        # row length = words_per_block + model.param_words (blake2's
+        # baked per-block parameters ride at the end; packing.py)
+        words = [base[b, w] for w in range(base.shape[1])]
         bb, w, s = tb_loc
         if bb == b:
             words[w] = words[w] | (tb << s)

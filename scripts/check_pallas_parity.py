@@ -35,9 +35,9 @@ def oracle_first_hit(mname: str, nonce: bytes, difficulty: int,
                      chunk0: int, batch: int) -> int:
     """Expected kernel result: min flat index whose digest has >=
     ``difficulty`` trailing zero nibbles, else SENTINEL."""
+    from distpow_tpu.models.puzzle import new_hash
     from distpow_tpu.ops.search_step import SENTINEL
 
-    h0 = getattr(hashlib, mname)
     log_tbc = TBC.bit_length() - 1
     best = SENTINEL
     for f in range(batch):
@@ -45,7 +45,11 @@ def oracle_first_hit(mname: str, nonce: bytes, difficulty: int,
         tb = f & (TBC - 1)
         secret = bytes([tb]) + (chunk & (256 ** WIDTH - 1)).to_bytes(
             WIDTH, "little")
-        if h0(nonce + secret).hexdigest().endswith("0" * difficulty):
+        # new_hash, not getattr(hashlib, ...): blake2b_256 is a
+        # PARAMETERIZED constructor with no hashlib attribute name
+        h = new_hash(mname)
+        h.update(nonce + secret)
+        if h.hexdigest().endswith("0" * difficulty):
             return f
     return best
 

@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 
 from distpow_tpu.models import (
+    blake2b_jax,
     md5_jax,
     ripemd160_jax,
     sha1_jax,
@@ -18,6 +19,7 @@ from distpow_tpu.models import (
     sha512_jax,
 )
 from distpow_tpu.models.registry import (
+    BLAKE2B_256,
     MD5,
     RIPEMD160,
     SHA1,
@@ -105,6 +107,7 @@ def test_md5_jax_vectorized_batch():
     (SHA512, hashlib.sha512),
     (SHA384, hashlib.sha384),
     (SHA3_256, hashlib.sha3_256),
+    (BLAKE2B_256, lambda m: hashlib.blake2b(m, digest_size=32)),
 ])
 @pytest.mark.parametrize("length", [0, 5, 63, 64, 70, 128, 129, 135, 136, 137])
 def test_py_twins_vs_hashlib(model, href, length):
@@ -112,7 +115,8 @@ def test_py_twins_vs_hashlib(model, href, length):
     msg = bytes(rng.randrange(256) for _ in range(length))
     mod = {MD5: md5_jax, SHA256: sha256_jax, SHA1: sha1_jax,
            RIPEMD160: ripemd160_jax, SHA512: sha512_jax,
-           SHA384: sha384_jax, SHA3_256: sha3_jax}[model]
+           SHA384: sha384_jax, SHA3_256: sha3_jax,
+           BLAKE2B_256: blake2b_jax}[model]
     assert mod.py_digest(msg) == href(msg).digest()
 
 
@@ -325,6 +329,53 @@ def test_sha3_jax_compress_batch_vs_hashlib():
     st = sha3_jax.sha3_256_compress(st, struct.unpack("<34I", bytes(t)))
     digest = b"".join(int(w).to_bytes(4, "little") for w in st[:8])
     assert digest == hashlib.sha3_256(long_msg).digest()
+
+
+def test_blake2b_registry_and_params():
+    """The per-block-parameter model's registry shape: blake2's byte
+    counter and finalization flag are compression inputs the packing
+    layer bakes as extra template words (HashModel.block_param_words) —
+    the structural axis no other model exercises."""
+    from distpow_tpu.models import blake2b_jax
+    from distpow_tpu.models.registry import BLAKE2B_256
+
+    assert get_hash_model("blake2b_256") is BLAKE2B_256
+    assert BLAKE2B_256.padding == "blake2"
+    assert BLAKE2B_256.param_words == 4
+    assert BLAKE2B_256.block_param_words is blake2b_jax.block_param_words
+    assert BLAKE2B_256.digest_words == 8 and BLAKE2B_256.max_difficulty == 64
+    # param derivation: non-final blocks count full message bytes,
+    # the final block the true length, finality all-ones
+    assert blake2b_jax.block_param_words(0, 200, 0, 2) == (128, 0, 0, 0)
+    assert blake2b_jax.block_param_words(0, 200, 1, 2) == (
+        200, 0, 0xFFFFFFFF, 0xFFFFFFFF)
+    assert blake2b_jax.block_param_words(256, 10, 0, 1) == (
+        266, 0, 0xFFFFFFFF, 0xFFFFFFFF)
+    # the template rows carry the params (packing layer)
+    from distpow_tpu.ops.packing import build_tail_spec
+
+    spec = build_tail_spec(b"\x01\x02", 2, BLAKE2B_256)
+    assert spec.n_blocks == 1
+    assert len(spec.base_words[0]) == 32 + 4
+    # t = 2 (nonce rem) + 1 (tb) + 2 (width) = 5; final
+    assert spec.base_words[0][32:] == (5, 0, 0xFFFFFFFF, 0xFFFFFFFF)
+
+
+def test_blake2b_search_matches_oracle():
+    """Mining parity end-to-end: zero-fill padding, baked per-block
+    params, including a host-absorbed full prefix block (the t counter
+    must carry across the absorb boundary)."""
+    from distpow_tpu.models import puzzle
+    from distpow_tpu.models.registry import BLAKE2B_256
+    from distpow_tpu.parallel.search import search
+
+    tbs = list(range(256))
+    for nonce in (b"\x27\x18", bytes(range(130))):
+        oracle = puzzle.python_search(nonce, 2, tbs, algo="blake2b_256")
+        got = search(nonce, 2, tbs, model=BLAKE2B_256, batch_size=1 << 13)
+        assert got is not None and got.secret == oracle
+        assert hashlib.blake2b(nonce + got.secret,
+                               digest_size=32).hexdigest().endswith("00")
 
 
 def test_sha3_search_matches_oracle():

@@ -161,6 +161,32 @@ def test_native_backend_sha3_matches_oracle():
     assert backend.search(long_nonce, 1, list(range(256))) == o2
 
 
+@pytest.mark.parametrize("length", [0, 127, 128, 129, 300])
+def test_native_blake2b_vs_hashlib(length):
+    """Blake2b256Traits digest hook: lengths bracket the full-final-
+    block edge (len % 128 == 0), where blake2 compresses the LAST full
+    block with last=true instead of absorbing it early."""
+    import random
+
+    rng = random.Random(8000 + length)
+    data = bytes(rng.randrange(256) for _ in range(length))
+    assert native.native_blake2b_256(data) == hashlib.blake2b(
+        data, digest_size=32).digest()
+
+
+def test_native_backend_blake2b_matches_oracle():
+    """The per-block-parameter trait through the generic scan loop:
+    kNeedsBlockParams routes (t, last) into CompressWithParams, with a
+    host-absorbed full prefix block carrying the counter across."""
+    from distpow_tpu.models import puzzle
+
+    backend = native.NativeBackend("blake2b_256", n_threads=1)
+    for nonce in (b"\x61\x43", bytes(range(130))):
+        oracle = puzzle.python_search(nonce, 2, list(range(256)),
+                                      algo="blake2b_256")
+        assert backend.search(nonce, 2, list(range(256))) == oracle
+
+
 def test_native_backend_sha1_matches_oracle():
     """Sha1Traits through the same templated scan loop: reference
     enumeration order for the third registry model too."""

@@ -409,6 +409,36 @@ def test_sha3_tile_nonzero_absorbed_state():
         assert int(out[j]) == ref_words[j], j
 
 
+def test_blake2b_tile_matches_hashlib_all_buckets():
+    """The per-block-parameter tile (round 4, eighth model): the baked
+    t/f limbs ride at the end of the 36-word template row, and the
+    final-round diagonal DCE elides exactly the dead digest words."""
+    import hashlib
+    import struct
+
+    from distpow_tpu.models.blake2b_py import BLAKE2B_INIT
+    from distpow_tpu.ops.md5_pallas import _blake2b_tile
+
+    msg = b"\x42\x24" + bytes(range(60))
+    t = bytearray(128)
+    t[: len(msg)] = msg
+    words = list(struct.unpack("<32I", bytes(t)))
+    words += [len(msg), 0, 0xFFFFFFFF, 0xFFFFFFFF]
+    wj = [jnp.uint32(w) for w in words]
+    init = [jnp.uint32(s) for s in BLAKE2B_INIT]
+    ref = struct.unpack(
+        "<8I", hashlib.blake2b(msg, digest_size=32).digest())
+    for mw in range(1, 9):
+        out = _blake2b_tile(wj, init, mw)
+        for j in range(8):
+            if out[j] is None:
+                assert j < 8 - mw, (mw, j)
+            else:
+                assert int(out[j]) == ref[j], (mw, j)
+        for j in range(8 - mw, 8):
+            assert out[j] is not None, (mw, j)
+
+
 def test_sha512_interpret_mode_falls_back():
     """Both kernel constructors — the single-device builder AND the
     mesh step factory (review r4: it bypassed the first guard) — must
@@ -424,7 +454,7 @@ def test_sha512_interpret_mode_falls_back():
     )
 
     mesh = make_mesh(jax.devices())
-    for mname in ("sha512", "sha384", "sha3_256"):
+    for mname in ("sha512", "sha384", "sha3_256", "blake2b_256"):
         with pytest.raises(ValueError, match="TPU-only"):
             build_pallas_search_step(
                 b"\x01\x02", 1, 3, 0, 256, 8, mname,

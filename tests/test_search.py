@@ -503,7 +503,8 @@ def test_search_differential_fuzz_all_models():
     pay ~3.4x sha256's op count per candidate on the CPU test mesh, so
     deeper difficulties dominate the slow set's wall-clock)."""
     from distpow_tpu.models.registry import (
-        MD5, RIPEMD160, SHA1, SHA3_256, SHA256, SHA384, SHA512,
+        BLAKE2B_256, MD5, RIPEMD160, SHA1, SHA3_256, SHA256, SHA384,
+        SHA512,
     )
 
     _fuzz_against_oracle(
@@ -511,8 +512,8 @@ def test_search_differential_fuzz_all_models():
          (RIPEMD160, "ripemd160")], seed=0xBEEF, n=7)
     _fuzz_against_oracle(
         [(SHA512, "sha512"), (SHA384, "sha384"),
-         (SHA3_256, "sha3_256")], seed=0xCAFE, n=6,
-        max_difficulty=2)
+         (SHA3_256, "sha3_256"), (BLAKE2B_256, "blake2b_256")],
+        seed=0xCAFE, n=6, max_difficulty=2)
 
 
 def test_early_exits_account_all_dispatched_work():
