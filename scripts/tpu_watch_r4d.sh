@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# End-of-round device watch: probe until the tunnel returns, then run
+# the final evidence queue — (1) a full bench (fresh last_measured
+# provenance incl. the blake2b lines the outage cut off), (2) the
+# registry-wide e2e latency sweep with blake2b.  Sequential, no kills
+# (docs/KERNELS.md provenance notes; memory: interrupting an active
+# TPU client has wedged the tunnel for hours).
+# Usage: scripts/tpu_watch_r4d.sh [outdir]  (default docs/artifacts/r4d)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-docs/artifacts/r4d}"
+mkdir -p "$OUT"
+
+echo "=== waiting for device ($(date +%T)) ===" | tee "$OUT/session.log"
+UP=0
+for i in $(seq 1 400); do
+  timeout 150 python -c "import jax, jax.numpy as jnp; assert int(jnp.uint32(2)+jnp.uint32(3))==5" 2>"$OUT/probe.err"
+  RC=$?
+  if [ "$RC" -eq 0 ]; then
+    echo "device up at $(date +%T)" | tee -a "$OUT/session.log"
+    UP=1
+    break
+  elif [ "$RC" -ne 124 ] && [ "$RC" -ne 143 ]; then
+    echo "probe CRASHED (rc=$RC) — broken environment, aborting:" \
+      | tee -a "$OUT/session.log"
+    tail -5 "$OUT/probe.err" | tee -a "$OUT/session.log"
+    exit 1
+  fi
+  sleep 90
+done
+if [ "$UP" -ne 1 ]; then
+  echo "device never appeared; aborting session" | tee -a "$OUT/session.log"
+  exit 1
+fi
+
+echo "=== full bench ===" | tee -a "$OUT/session.log"
+python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
+cat "$OUT/bench.json" | tee -a "$OUT/session.log"
+
+echo "=== registry e2e latency (incl. blake2b) ===" | tee -a "$OUT/session.log"
+timeout 2400 python scripts/e2e_models.py 6 "$OUT/e2e_models.json" \
+  >"$OUT/e2e_models.out" 2>"$OUT/e2e_models.log"
+cat "$OUT/e2e_models.json" 2>/dev/null | tee -a "$OUT/session.log"
+
+echo "=== done $(date +%T) ===" | tee -a "$OUT/session.log"
