@@ -8,7 +8,15 @@ import numpy as np
 import pytest
 
 from distpow_tpu.models import puzzle
-from distpow_tpu.models.registry import MD5, RIPEMD160, SHA1, SHA256, SHA512
+from distpow_tpu.models.registry import (
+    BLAKE2B_256,
+    MD5,
+    RIPEMD160,
+    SHA1,
+    SHA3_256,
+    SHA256,
+    SHA512,
+)
 from distpow_tpu.ops.difficulty import meets_difficulty, nibble_masks
 from distpow_tpu.ops.packing import build_tail_spec, make_words, pack_reference_bytes
 from distpow_tpu.ops.search_step import (
@@ -23,7 +31,7 @@ def digest_of(spec, model, tb, chunk):
     for b in range(spec.n_blocks):
         words = make_words(spec, jnp.uint32(tb), jnp.uint32(chunk))[b]
         state = model.compress(state, words)
-    return b"".join(int(w).to_bytes(4, model.word_byteorder) for w in state)
+    return model.state_to_digest(state)
 
 
 @pytest.mark.parametrize("model", [
@@ -55,6 +63,31 @@ def test_packing_extra_const_chunk():
     msg = pack_reference_bytes(nonce, 7, 0xDEADBEEF, 4, extra)
     assert digest_of(spec, MD5, 7, 0xDEADBEEF) == hashlib.md5(msg).digest()
     assert len(msg) == 4 + 1 + 4 + 2
+
+
+@pytest.mark.parametrize("model", [SHA3_256, BLAKE2B_256])
+@pytest.mark.parametrize("nonce_len", [0, 4, 130, 135, 260])
+@pytest.mark.parametrize("width", [0, 2, 4])
+def test_packing_matches_hashlib_sponge_and_params(model, nonce_len, width):
+    """The two non-Merkle-Damgard packing families through the full
+    template path: sha3's pad10*1 and blake2's zero-fill + baked (t, f)
+    parameter words, across host-absorption boundaries (nonce lengths
+    bracket the 128/136-byte blocks) and chunk widths — including the
+    width>4 extra-const-chunk mechanism, whose bytes must count into
+    blake2's byte counter."""
+    rng = random.Random(nonce_len * 13 + width)
+    nonce = bytes(rng.randrange(256) for _ in range(nonce_len))
+    for extra in (b"", b"\x09\x02"):
+        spec = build_tail_spec(nonce, width, model, extra_const_chunk=extra)
+        for _ in range(2):
+            tb = rng.randrange(256)
+            chunk = rng.randrange(256 ** width) if width else 0
+            msg = pack_reference_bytes(nonce, tb, chunk, width, extra)
+            expect = model.hashlib_new()
+            expect.update(msg)
+            assert digest_of(spec, model, tb, chunk) == expect.digest(), (
+                model.name, nonce_len, width, extra
+            )
 
 
 @pytest.mark.parametrize("model", [MD5, SHA256, SHA1, SHA512])
