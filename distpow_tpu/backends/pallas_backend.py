@@ -1,11 +1,11 @@
 """Worker backend driving the Pallas hash kernels through the search loop.
 
-Plugs ``ops.md5_pallas`` (MD5 and SHA-256 kernels, each with a
-hardware-swept tile geometry) into ``parallel.search`` via the
-step-factory protocol.  Launch geometry: the batch is rounded to a
-whole number of (sublanes, 128) tiles; configurations the kernel cannot
-express (non-power-of-two thread-byte runs, multi-block tails, models
-without a kernel) fall back to the fused XLA step transparently.
+Plugs ``ops.md5_pallas`` (a hardware-swept tile for every registry
+model) into ``parallel.search`` via the step-factory protocol.  Launch
+geometry: the batch is rounded to a whole number of (sublanes, 128)
+tiles; configurations the kernel cannot express (non-power-of-two
+thread-byte runs, multi-block tails, TPU-only tiles under interpret
+mode) fall back to the fused XLA step transparently.
 """
 
 from __future__ import annotations

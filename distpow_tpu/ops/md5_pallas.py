@@ -1,5 +1,6 @@
-"""Pallas TPU kernels for the fused proof-of-work search step
-(MD5, SHA-256, SHA-1, RIPEMD-160 — every ``_TILE_FNS`` model).
+"""Pallas TPU kernels for the fused proof-of-work search step — every
+registry hash model has a tile (``_TILE_FNS``): MD5, SHA-256, SHA-1,
+RIPEMD-160, SHA-512, SHA-384, SHA3-256, BLAKE2b-256.
 
 The hot op of the framework (SURVEY.md section 7 layer 4, the "north
 star"): one kernel launch evaluates a dense tile grid of candidates —

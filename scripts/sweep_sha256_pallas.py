@@ -6,10 +6,9 @@ Measures candidates/sec for (sublanes, inner) combinations at the
 serving launch shape (width-4 chunks, full 256-byte partition,
 difficulty 8 nibbles) and prints a ranked table plus the XLA serving
 rate for reference.  Feed the winner back into
-``ops/md5_pallas.py MODEL_GEOMETRY[model]``.  Default model: sha256
-(the sweep that shipped (16, 1024), docs/KERNELS.md); ``--model sha1``
-sweeps the round-3 SHA-1 kernel, whose shipped geometry is by analogy
-only and unswept.
+``ops/md5_pallas.py MODEL_GEOMETRY[model]``.  Default model: sha256;
+``--model NAME`` sweeps any ``_TILE_FNS`` model (every shipped
+geometry's provenance is the sweep logs under ``docs/artifacts/``).
 """
 
 from __future__ import annotations
