@@ -136,7 +136,15 @@ def _blake2b_compress_jit(state, words):
                    m_lo[xi], m_hi[xi], m_lo[yi], m_hi[yi])
         return jnp.stack(st)
 
-    st0 = jnp.stack([limb for pair in v for limb in pair])
+    # shard_map varying-axis typing: under a mesh step the message
+    # words are device-varying while v[8..15] start as replicated IV
+    # constants; one round would flip the fori carry's varying type and
+    # break the carry-in == carry-out invariant (caught by the r5
+    # multichip dryrun's blake2b leg).  XOR-in a zero derived from
+    # every dynamic input: value-neutral (XLA folds it), but it
+    # promotes the whole carry to the words' varying type up front.
+    vz = (m_lo.sum(0) + m_hi.sum(0) + t_lo + t_hi + f_lo + f_hi) & U32(0)
+    st0 = jnp.stack([limb ^ vz for pair in v for limb in pair])
     out = lax.fori_loop(0, ROUNDS, round_body, st0)
 
     res = []

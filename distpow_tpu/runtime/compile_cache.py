@@ -11,6 +11,10 @@ driver's separate round-end bench run on the same machine.
 from __future__ import annotations
 
 import logging
+import threading
+import warnings
+
+from distpow_tpu.runtime.metrics import REGISTRY
 
 log = logging.getLogger("distpow.compile_cache")
 
@@ -20,6 +24,112 @@ DEFAULT_DIR = "/tmp/xla_cache"
 # worker would have persisted.
 MIN_COMPILE_SECS = 0.5
 
+# Counter names (REGISTRY): total plus a read/write/keygen breakdown.
+# The worker's Stats RPC ships the registry snapshot, so a failing
+# cache shows up in ``python -m distpow_tpu.cli.stats`` instead of as
+# one stderr line nobody reads (VERDICT r4 item 2: bench7's
+# ``UNAVAILABLE`` persistent-cache read error went unnoticed and
+# silently cost the run its warm start).
+ERRORS_TOTAL = "compile_cache.errors"
+ERRORS_READ = "compile_cache.read_errors"
+ERRORS_WRITE = "compile_cache.write_errors"
+ERRORS_KEYGEN = "compile_cache.keygen_errors"
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _classify(message: str) -> str | None:
+    """Map a jax cache-failure message to a breakdown counter.
+
+    The upstream shapes (jax._src/compiler.py): read/write failures are
+    ``warnings.warn("Error reading|writing persistent compilation cache
+    entry for ...")``; cache-key failures are ``logger.error(
+    "compile_or_get_cached: unable to generate cache key, ...")``; the
+    lru_cache eviction layer warns with its own messages mentioning the
+    compilation cache.  Matching is deliberately loose on everything
+    but the read/write verbs so a minor upstream rewording degrades to
+    the total counter, not to silence.
+    """
+    m = message.lower()
+    if "compilation cache" not in m and "cache key" not in m:
+        return None
+    if "error reading" in m or "read" in m.split("cache")[0]:
+        return ERRORS_READ
+    if "error writing" in m or "writ" in m.split("cache")[0]:
+        return ERRORS_WRITE
+    if "cache key" in m:
+        return ERRORS_KEYGEN
+    return ERRORS_TOTAL
+
+
+def _count(message: str, origin: str) -> bool:
+    kind = _classify(message)
+    if kind is None:
+        return False
+    REGISTRY.inc(ERRORS_TOTAL)
+    if kind != ERRORS_TOTAL:
+        REGISTRY.inc(kind)
+    log.warning("persistent compile cache error (%s, counted as %s): %s",
+                origin, kind, message[:300])
+    return True
+
+
+class _CacheErrorLogHandler(logging.Handler):
+    """Counts jax's logger-path cache failures (keygen errors)."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if record.levelno >= logging.ERROR:
+            try:
+                _count(record.getMessage(), "log")
+            except Exception:  # a metrics bug must never break logging
+                pass
+
+
+def _install_error_counters() -> None:
+    """Intercept both failure channels, once per process.
+
+    * ``warnings.showwarning`` is wrapped (and chained — the original
+      still runs, so nothing disappears from stderr) to count the
+      read/write entry failures.
+    * a handler on the ``jax._src.compiler`` logger counts the
+      cache-key failure path.
+    """
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        _installed = True
+
+        prev = warnings.showwarning
+
+        def showwarning(message, category, filename, lineno,
+                        file=None, line=None):
+            try:
+                _count(str(message), "warning")
+            except Exception:
+                pass
+            prev(message, category, filename, lineno, file, line)
+
+        warnings.showwarning = showwarning
+        # Without this, Python's "default" filter action dedupes repeat
+        # warnings per (text, category, lineno) — a cache failing the
+        # same way on every entry would count as ~1 error total, hiding
+        # an ongoing outage behind a one-transient-shaped metric
+        # (review r5).  Force every cache-entry failure through the
+        # display path (and hence the counter).
+        warnings.filterwarnings(
+            "always", message=r".*persistent compilation cache.*"
+        )
+        logging.getLogger("jax._src.compiler").addHandler(
+            _CacheErrorLogHandler()
+        )
+
+
+def error_count() -> int:
+    """Total persistent-cache errors counted so far (testing/ops hook)."""
+    return int(REGISTRY.get(ERRORS_TOTAL))
+
 
 def enable(cache_dir: str = DEFAULT_DIR,
            min_compile_secs: float = MIN_COMPILE_SECS) -> bool:
@@ -27,15 +137,33 @@ def enable(cache_dir: str = DEFAULT_DIR,
 
     Returns True on success; failures are logged (never silent — an
     unwritable directory or renamed config key would otherwise disable
-    caching with no trace) and never raised.
+    caching with no trace) and never raised.  Also installs the error
+    counters above, so every caller of ``enable`` gets accounting for
+    free.
     """
+    _install_error_counters()
     try:
         import jax
 
+        prev_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", min_compile_secs
         )
+        if prev_dir is not None and prev_dir != cache_dir:
+            # jax initializes its cache object lazily at the first
+            # compile and then IGNORES config-dir changes; re-pointing
+            # the dir after any compile (a worker rebooting onto a new
+            # CompilationCacheDir in-process) would silently keep
+            # writing to the old one.  reset_cache() returns it to the
+            # uninitialized state so the next compile binds the new dir.
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception as exc:  # private API: degrade to a log line
+                log.warning("could not reset jax cache object after dir "
+                            "change %s -> %s: %s", prev_dir, cache_dir, exc)
         return True
     except Exception as exc:
         log.warning("persistent compile cache unavailable (%s): %s",

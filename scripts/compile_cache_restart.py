@@ -1,0 +1,135 @@
+"""Cold vs cache-hot worker boot: does the persistent compile cache pay?
+
+VERDICT r4 item 2: ``runtime/compile_cache.py`` exists and every entry
+point shares it, yet no artifact demonstrates a cache-hot process
+restart booting faster than a cold one — and r4's e2e runs still showed
+38-41 s warmups for sha384/sha512.  This script measures it directly:
+
+for each model, boot a FRESH process twice against a dedicated cache
+directory — once with the directory emptied (cold: every program
+compiles), once reusing what the first boot persisted (warm: disk
+hits) — timing ``backend.warmup([4], [0..4])`` exactly as a booted
+worker warms (``WorkerConfig.WarmupNonceLens``).  Each child also
+reports ``compile_cache.error_count()`` so a silently failing cache
+(the bench7 ``UNAVAILABLE`` read error) shows up as a nonzero count
+next to a bogus "warm" time instead of invisibly poisoning the
+comparison.
+
+Usage:
+    python scripts/compile_cache_restart.py [models...] [--out FILE]
+Defaults: sha384 sha512 (the r4 worst cases) plus md5 as the fast
+control.  Reference contrast: a restarted reference worker starts
+completely cold every time (/root/reference/worker.go:116-126 — its
+caches are in-memory only and there is nothing like a compile to
+persist); ours must provably not.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+DEFAULT_MODELS = ["md5", "sha384", "sha512"]
+CACHE_DIR = "/tmp/xla_cache_restart_probe"
+
+_CHILD = r"""
+import json, os, sys, time
+model, cache_dir = sys.argv[1], sys.argv[2]
+force = os.environ.get("BENCH_FORCE_PLATFORM")
+if force:
+    import jax
+    jax.config.update("jax_platforms", force)
+from distpow_tpu.runtime import compile_cache
+compile_cache.enable(cache_dir)
+from distpow_tpu.backends import get_backend
+t0 = time.time()
+backend = get_backend("auto", hash_model=model, batch_size=1 << 21)
+backend.warmup([4], [0, 1, 2, 3, 4])
+warm_s = time.time() - t0
+print(json.dumps({
+    "model": model,
+    "backend": type(backend).__name__,
+    "warmup_s": round(warm_s, 2),
+    "cache_errors": compile_cache.error_count(),
+}))
+"""
+
+
+def boot_once(model: str, timeout_s: float) -> dict:
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD, model, CACHE_DIR],
+        capture_output=True, text=True, timeout=timeout_s,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"{model} boot failed: {out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    rec["process_s"] = round(time.time() - t0, 2)
+    for line in out.stderr.splitlines():
+        if "compile cache error" in line:
+            print(f"  [child stderr] {line}", file=sys.stderr)
+    return rec
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:]]
+    outfile = None
+    if "--out" in args:
+        i = args.index("--out")
+        outfile = args[i + 1]
+        del args[i:i + 2]
+    models = args or DEFAULT_MODELS
+    # one boot can legitimately take tens of minutes cold on the
+    # tunneled backend (sha512's serving compile is why the pallas
+    # backend exists); the warmup path compiles kernels, not that graph,
+    # so 15 min is a generous per-boot ceiling
+    timeout_s = float(os.environ.get("RESTART_PROBE_TIMEOUT_S", "900"))
+
+    report = {"cache_dir": CACHE_DIR, "models": {}}
+    for model in models:
+        # per-model isolation: on the fragile tunnel one boot hanging
+        # must cost that model's rows, not the whole report (the same
+        # per-stage degradation bench.py uses)
+        try:
+            # cold: empty the dedicated directory so nothing carries
+            # over from previous probes (the shared /tmp/xla_cache is
+            # untouched)
+            shutil.rmtree(CACHE_DIR, ignore_errors=True)
+            os.makedirs(CACHE_DIR, exist_ok=True)
+            print(f"[restart] {model}: cold boot ...", file=sys.stderr)
+            cold = boot_once(model, timeout_s)
+            print(f"[restart] {model}: cold warmup {cold['warmup_s']}s "
+                  f"(errors={cold['cache_errors']})", file=sys.stderr)
+            print(f"[restart] {model}: warm boot ...", file=sys.stderr)
+            warm = boot_once(model, timeout_s)
+            print(f"[restart] {model}: warm warmup {warm['warmup_s']}s "
+                  f"(errors={warm['cache_errors']})", file=sys.stderr)
+        except (RuntimeError, subprocess.TimeoutExpired, ValueError) as exc:
+            print(f"[restart] {model}: FAILED: {exc}", file=sys.stderr)
+            report["models"][model] = {"error": str(exc)[:500]}
+            continue
+        entry = {
+            "backend": cold["backend"],
+            "cold_warmup_s": cold["warmup_s"],
+            "warm_warmup_s": warm["warmup_s"],
+            "speedup": round(cold["warmup_s"] / max(warm["warmup_s"], 1e-9),
+                             1),
+            "cold_cache_errors": cold["cache_errors"],
+            "warm_cache_errors": warm["cache_errors"],
+        }
+        report["models"][model] = entry
+
+    line = json.dumps(report)
+    print(line)
+    if outfile:
+        with open(outfile, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,210 @@
+"""bench.py's outage-shaping logic: the anomaly screen and stage order.
+
+VERDICT r4 item 1: a degraded-tunnel transient must never silently
+replace provenance (the ``sha3_256-serving: 0.9`` case), and the stage
+order must put every model's production path ahead of the diagnostic
+XLA serving lines so a mid-run tunnel death costs only the tail.
+
+These tests import bench.py as a module — its module level is
+deliberately jax-free, so they run anywhere.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_module", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+LAST = {"rates_mhs": {"serving": 9766.8, "sha3_256-serving": 6.3,
+                      "blake2b_256-pallas": 974.9}}
+
+
+def test_screen_accepts_normal_readings(bench):
+    accepted, suspect = bench.screen_rates(
+        {"serving": 9900.0, "sha3_256-serving": 6.0}, LAST
+    )
+    assert suspect == {}
+    assert accepted == {"serving": 9900.0, "sha3_256-serving": 6.0}
+
+
+def test_screen_flags_degraded_low_reading(bench):
+    # the bench7 case: sha3 serving measured 0.85 MH/s on a dying
+    # tunnel vs 6.3 measured same-day — >3x low is suspect, provenance
+    # keeps the previous value, the reading is recorded with context
+    accepted, suspect = bench.screen_rates({"sha3_256-serving": 0.85}, LAST)
+    assert accepted["sha3_256-serving"] == 6.3
+    info = suspect["sha3_256-serving"]
+    assert info["measured_mhs"] == 0.85
+    assert info["last_measured_mhs"] == 6.3
+    assert info["ratio"] < 1 / 3
+
+
+def test_screen_flags_inflated_high_reading(bench):
+    # sync-artifact inflation (the block_until_ready failure mode) is
+    # equally suspect in the other direction
+    accepted, suspect = bench.screen_rates(
+        {"blake2b_256-pallas": 974.9 * 5}, LAST
+    )
+    assert accepted["blake2b_256-pallas"] == 974.9
+    assert suspect["blake2b_256-pallas"]["ratio"] > 3
+
+
+def test_screen_boundary_is_exactly_3x(bench):
+    # 3.0x exactly is NOT suspect (tolerance is strict inequality);
+    # just over is
+    accepted, suspect = bench.screen_rates({"serving": 9766.8 * 3}, LAST)
+    assert suspect == {}
+    _, suspect = bench.screen_rates({"serving": 9766.8 * 3.01}, LAST)
+    assert "serving" in suspect
+
+
+def test_screen_new_stage_without_history_is_accepted(bench):
+    # a stage with no previous measurement (a new model's first bench
+    # line) cannot be screened; it enters provenance as measured
+    accepted, suspect = bench.screen_rates({"blake2b_256-serving": 16.0}, LAST)
+    assert suspect == {}
+    assert accepted["blake2b_256-serving"] == 16.0
+
+
+def test_screen_without_any_last_measured(bench):
+    accepted, suspect = bench.screen_rates({"serving": 123.4}, None)
+    assert suspect == {}
+    assert accepted == {"serving": 123.4}
+
+
+def test_screen_override_env(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_ACCEPT_ANOMALIES", "1")
+    accepted, suspect = bench.screen_rates({"sha3_256-serving": 0.85}, LAST)
+    assert suspect == {}
+    assert accepted["sha3_256-serving"] == 0.85
+
+
+LAST_FULL = {
+    "value": 10089.2, "vs_baseline": 1830.3,
+    "rates_mhs": {"serving": 9766.8, "xla-static": 10089.2,
+                  "pallas": 9951.4, "sha1-pallas": 4368.4,
+                  "blake2b_256-pallas": 974.9},
+}
+
+
+def test_finalize_headline_selected_on_screened_values(bench):
+    """An inflated suspect reading can't steal the headline path: the
+    selection runs on screened values, so a healthy serving measurement
+    from the same run wins over a 9x-inflated pallas artifact."""
+    rates_hs = {"serving": 9800.0e6, "xla-static": 9700.0e6,
+                "pallas": 90_000.0e6}
+    line, prov = bench.finalize_record(rates_hs, LAST_FULL, 5.35e6)
+    assert "serving path" in line["metric"]
+    assert line["value"] == 9800.0
+    assert "pallas" in line["suspect_readings"]
+    # provenance: pallas keeps its previous standing, serving is fresh
+    assert prov["rates_mhs"]["pallas"] == 9951.4
+    assert prov["rates_mhs"]["serving"] == 9800.0
+    assert prov["value"] == 9800.0
+
+
+def test_finalize_deflated_suspect_cannot_win_selection(bench):
+    """Symmetric to the inflation case: a transiently-degraded serving
+    reading must not keep the headline via its stale-high screened
+    value when another md5 path measured clean in the same run."""
+    rates_hs = {"serving": 80.0e6, "xla-static": 9700.0e6}
+    line, prov = bench.finalize_record(rates_hs, LAST_FULL, 5.35e6)
+    assert "xla-static path" in line["metric"]
+    assert line["value"] == 9700.0
+    assert "serving" in line["suspect_readings"]
+    assert prov["rates_mhs"]["serving"] == 9766.8  # carried standing
+
+
+def test_finalize_suspect_headline_protects_provenance(bench):
+    """All md5 readings degraded (transient window): stdout stays the
+    honest measurement, flagged; provenance keeps the previous
+    standing for value, vs_baseline, and rates."""
+    rates_hs = {"serving": 80.0e6, "xla-static": 82.0e6}
+    line, prov = bench.finalize_record(rates_hs, LAST_FULL, 5.35e6)
+    assert "suspect" in line["metric"]
+    assert line["value"] in (80.0, 82.0)
+    assert prov["value"] == prov["rates_mhs"][
+        "serving" if "serving path" in line["metric"] else "xla-static"]
+    # provenance headline = previous standing, not the degraded reading
+    assert prov["value"] > 9000
+    assert prov["vs_baseline"] > 1000
+
+
+def test_finalize_carried_forward_is_explicit(bench):
+    """Stages not measured this run are merged from the previous
+    provenance under an explicit marker — stale vs fresh stays
+    distinguishable under the new date/run_id."""
+    rates_hs = {"serving": 9800.0e6, "pallas": 9900.0e6}
+    line, prov = bench.finalize_record(rates_hs, LAST_FULL, 5.35e6)
+    assert prov["rates_mhs"]["sha1-pallas"] == 4368.4
+    assert prov["rates_mhs"]["blake2b_256-pallas"] == 974.9
+    assert set(prov["carried_forward"]) == {"xla-static", "sha1-pallas",
+                                            "blake2b_256-pallas"}
+    # the stdout line never carries stale rates at all
+    assert "carried_forward" not in line
+
+
+def test_finalize_bailout_note_and_no_baseline(bench):
+    """The hang-bailout shape: note lands in metric + provenance, and
+    with no baseline measured this run vs_baseline derives from the
+    provenance file's own ratio."""
+    rates_hs = {"serving": 9766.8e6}
+    line, prov = bench.finalize_record(
+        rates_hs, LAST_FULL, None, note="device hung during later stages"
+    )
+    assert "device hung" in line["metric"]
+    assert prov["note"] == "device hung during later stages"
+    # baseline MH/s from provenance = 10089.2/1830.3 = 5.513; measured
+    # 9766.8 / 5.513 = 1771.6
+    assert 1700 < line["vs_baseline"] < 1850
+
+
+def test_finalize_no_history(bench):
+    line, prov = bench.finalize_record(
+        {"serving": 100.0e6}, None, 5.0e6
+    )
+    assert line["value"] == 100.0
+    assert line["vs_baseline"] == 20.0
+    assert "suspect_readings" not in line
+    assert "carried_forward" not in prov
+
+
+def test_stage_order_production_before_diagnostics(bench):
+    """Source-order invariant: every production pallas line is emitted
+    before any non-md5 serving diagnostic, and the HBM-bound serving
+    lines come first within the diagnostics (they are this round's
+    reconciliation targets and the cheapest)."""
+    src = open(_BENCH).read()
+    phase_b = src.index("Phase B")
+    phase_e = src.index("Phase E")
+    assert phase_b < src.index("Phase C") < src.index("Phase D") < phase_e
+    # blake2b is in the production set and in the HBM-bound serving set
+    assert "blake2b_256" in bench.OTHER_MODELS
+    assert "blake2b_256" in bench.HBM_BOUND_SERVING
+    assert "sha3_256" in bench.HBM_BOUND_SERVING
+    # sha512/sha384 serving stays impossible-by-construction
+    from distpow_tpu.ops.search_step import XLA_SERVING_COMPILE_IMPRACTICAL
+
+    assert {"sha512", "sha384"} <= set(XLA_SERVING_COMPILE_IMPRACTICAL)
+
+
+def test_module_level_is_jax_free(bench):
+    """The device-unreachable fast path must not import jax at module
+    level (the probe runs in a subprocess; a hung backend would wedge
+    the parent import otherwise)."""
+    src = open(_BENCH).read()
+    head = src[: src.index("def device_rate")]
+    assert "import jax" not in head

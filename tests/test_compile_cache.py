@@ -1,0 +1,149 @@
+"""Persistent compile-cache error accounting (VERDICT r4 item 2).
+
+bench7 (r4) logged a persistent-cache read error (``UNAVAILABLE: TPU
+backend setup/compile error``) that nothing surfaced or counted — the
+run silently lost its warm start.  These tests pin the two interception
+channels: jax's ``warnings.warn`` read/write-entry failures and the
+``jax._src.compiler`` logger's cache-key failures, both counted into
+the process metrics registry that the Stats RPC ships.
+
+The warnings channel is exercised in a SUBPROCESS: pytest's own
+warnings plugin replaces ``warnings.showwarning`` around every test
+(``catch_warnings(record=True)``), which would bypass the chained
+production wrapper and test pytest instead of the repo.
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import sys
+
+import pytest
+
+from distpow_tpu.runtime import compile_cache
+from distpow_tpu.runtime.metrics import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    compile_cache._install_error_counters()
+    yield
+    REGISTRY.reset()
+
+
+def test_read_error_classified_and_counted():
+    assert compile_cache._count(
+        "Error reading persistent compilation cache entry for "
+        "'jit_search_step': UNAVAILABLE: TPU backend setup/compile error",
+        "warning",
+    )
+    assert compile_cache.error_count() == 1
+    assert REGISTRY.get(compile_cache.ERRORS_READ) == 1
+    assert REGISTRY.get(compile_cache.ERRORS_WRITE) == 0
+
+
+def test_write_error_classified_and_counted():
+    assert compile_cache._count(
+        "Error writing persistent compilation cache entry for "
+        "'jit_run': PERMISSION_DENIED: /tmp/xla_cache",
+        "warning",
+    )
+    assert REGISTRY.get(compile_cache.ERRORS_WRITE) == 1
+    assert compile_cache.error_count() == 1
+
+
+def test_keygen_log_error_is_counted():
+    # the logger channel is NOT touched by pytest's warning capture, so
+    # this exercises the real production handler end to end
+    logging.getLogger("jax._src.compiler").error(
+        "compile_or_get_cached: unable to generate cache key, "
+        "skipping the cache: boom"
+    )
+    assert REGISTRY.get(compile_cache.ERRORS_KEYGEN) == 1
+    assert compile_cache.error_count() == 1
+
+
+def test_unrelated_messages_not_counted():
+    assert not compile_cache._count("Some unrelated deprecation", "warning")
+    logging.getLogger("jax._src.compiler").error("unrelated error")
+    # non-ERROR cache chatter (the "Not writing ... since cache is
+    # disabled" info lines) must not count either
+    logging.getLogger("jax._src.compiler").info(
+        "Not writing persistent cache entry with key 'k' since cache "
+        "is disabled/not initialized"
+    )
+    assert compile_cache.error_count() == 0
+
+
+def test_warnings_channel_intercepts_in_fresh_process():
+    """End-to-end: in a pristine process (no pytest warning capture),
+    a jax-shaped cache read warning increments the counter AND still
+    reaches the normal warning display (the chain forwards)."""
+    code = (
+        "import warnings, sys\n"
+        "from distpow_tpu.runtime import compile_cache\n"
+        "compile_cache._install_error_counters()\n"
+        # deliberately NO simplefilter: the production install must
+        # count REPEAT identical failures too (Python's 'default'
+        # action would dedupe the second warn from the same site, and
+        # an ongoing cache outage would look like one transient)
+        "for _ in range(2):\n"
+        "    warnings.warn('Error reading persistent compilation cache "
+        "entry for jit_x: UNAVAILABLE: boom')\n"
+        "warnings.warn('unrelated warning')\n"
+        "print('COUNT', compile_cache.error_count())\n"
+    )
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, cwd=repo_root,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "COUNT 2" in out.stdout
+    # the original warning still printed (stderr) — interception is a
+    # chain, not a replacement
+    assert "UNAVAILABLE: boom" in out.stderr
+
+
+def test_install_is_idempotent():
+    import warnings as w
+
+    before = w.showwarning
+    compile_cache._install_error_counters()
+    compile_cache._install_error_counters()
+    assert w.showwarning is before
+    # double-install must not stack log handlers either
+    handlers = [
+        h for h in logging.getLogger("jax._src.compiler").handlers
+        if isinstance(h, compile_cache._CacheErrorLogHandler)
+    ]
+    assert len(handlers) == 1
+
+
+def test_enable_installs_counters_and_sets_config():
+    import jax
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_secs = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        assert compile_cache.enable("/tmp/xla_cache_test_dir") is True
+        assert (jax.config.jax_compilation_cache_dir
+                == "/tmp/xla_cache_test_dir")
+        # re-pointing the dir must take effect even though jax binds its
+        # cache object lazily and ignores later config edits: enable()
+        # resets the cache object on a dir change (the in-process
+        # worker-reboot scenario test_nodes exercises end to end)
+        assert compile_cache.enable("/tmp/xla_cache_test_dir2") is True
+        assert (jax.config.jax_compilation_cache_dir
+                == "/tmp/xla_cache_test_dir2")
+    finally:
+        # restore: leaving the persistent cache globally enabled would
+        # couple every later test's compiles to /tmp state
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_secs
+        )

@@ -218,18 +218,22 @@ def test_mesh_search_sha1_model():
 
 @pytest.mark.slow
 def test_mesh_search_new_models():
-    """ripemd160 and sha512 through the shard_map mesh step (round 4):
+    """ripemd160, sha512, and blake2b through the shard_map mesh step:
     the stacked-window sha512 loop form must carry cleanly under
-    shard_map's varying-axis types, and the two-line ripemd compression
-    must shard like any other."""
+    shard_map's varying-axis types, the two-line ripemd compression
+    must shard like any other, and blake2b's fori carry must stay
+    vma-uniform although half its initial limbs are replicated IV
+    constants (the r5 multichip-dryrun regression — blake2b_jax.py's
+    varying-zero promotion)."""
     import jax
 
-    from distpow_tpu.models.registry import RIPEMD160, SHA512
+    from distpow_tpu.models.registry import RIPEMD160, SHA512, get_hash_model
     from distpow_tpu.parallel.mesh_search import make_mesh, search_mesh
 
     mesh = make_mesh(jax.devices())
     tbs = list(range(256))
-    for model, algo in ((RIPEMD160, "ripemd160"), (SHA512, "sha512")):
+    for model, algo in ((RIPEMD160, "ripemd160"), (SHA512, "sha512"),
+                        (get_hash_model("blake2b_256"), "blake2b_256")):
         oracle = puzzle.python_search(b"\x0a\x0b", 2, tbs, algo=algo)
         got = search_mesh(b"\x0a\x0b", 2, tbs, model=model, mesh=mesh,
                           batch_size=1 << 13)
