@@ -460,6 +460,10 @@ def main() -> None:
                 snap, lm, state["baseline"],
                 note="partial run: device hung after these stages",
             )
+            if state.get("roofline"):
+                prov["roofline_tops"] = round(state["roofline"] / 1e12, 3)
+            elif lm and lm.get("roofline_tops"):
+                prov["roofline_tops"] = lm["roofline_tops"]
             _write_last_measured(prov)
         else:
             line = {
@@ -608,6 +612,7 @@ def main() -> None:
     MD5_OPS_PER_HASH = get_hash_model("md5").cost_ops
     try:
         roofline = measured_vpu_roofline()
+        state["roofline"] = roofline
     except Exception as exc:  # degrade like the rate sections above
         print(f"[bench] roofline microbenchmark failed: {exc}",
               file=sys.stderr)
@@ -813,6 +818,13 @@ def main() -> None:
 
     # ---- Final line ---------------------------------------------------
     line, prov = finalize_record(rates, last_measured, baseline)
+    # the measured roofline rides in provenance: the generated
+    # registry-standing table (scripts/gen_registry_table.py) derives
+    # utilization percentages from it
+    if roofline:
+        prov["roofline_tops"] = round(roofline / 1e12, 3)
+    elif last_measured and last_measured.get("roofline_tops"):
+        prov["roofline_tops"] = last_measured["roofline_tops"]
     for lbl, info in line.get("suspect_readings", {}).items():
         print(f"[bench] SUSPECT reading for {lbl}: "
               f"{info['measured_mhs']} MH/s vs last measured "

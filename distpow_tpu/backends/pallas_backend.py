@@ -74,6 +74,42 @@ class PallasBackend:
             # rounded_batch * k must stay within the uint32 flat-index
             # bound (_check_launch) and the dispatch budget
             k = max(1, min(launch_steps, self.max_launch // batch))
+            # keep the tuned inner effective for non-power-of-two tiles
+            # (the sweep-best sublanes=24 geometries): the kernel
+            # shrinks inner until it divides the per-dispatch tile
+            # count, and a 24-sublane tile leaves 2^21 candidates at
+            # 683 tiles — prime — so with an odd launch multiplier the
+            # inner loop collapses all the way to 1 (the review-r4 trap
+            # that kept those geometries unshippable).  Two bounded
+            # moves fix it: round k down to a power of two (so the
+            # dispatch tile count carries pow2 factors), then grow the
+            # batch by whole tiles until k*n_tiles divides inner —
+            # but ONLY when the growth is marginal (<=2%) and the k
+            # clamp is unaffected; otherwise keep the old
+            # shrink-inner behavior (review r5: an uncapped version of
+            # this grew small width segments 4x and blew the dispatch
+            # budget the k clamp above enforces).  For all-power-of-two
+            # geometries every condition already holds: no-op.
+            if self.inner > 1 and (tile & (tile - 1)):
+                import math
+
+                k = 1 << (k.bit_length() - 1)
+                need = self.inner // math.gcd(k, self.inner)
+                n = batch // tile
+                if n % need:
+                    cap = batch + max(tile, batch // 50)
+                    grown = n + (need - n % need)
+                    while grown * tile <= cap and (grown * tile) % tbc:
+                        grown += need
+                    gbatch = grown * tile
+                    # the k in use must still fit the budget at the
+                    # grown batch (compare in pow2-rounded space)
+                    reclamp = max(1, min(launch_steps,
+                                         self.max_launch // gbatch))
+                    if (gbatch <= cap and gbatch % tbc == 0
+                            and 1 << (reclamp.bit_length() - 1) >= k):
+                        batch = gbatch
+                        chunks = max(1, batch // tbc)
             try:
                 # launch_steps just extends the kernel's sequential grid
                 # (ops/md5_pallas.py), so the kernel serves the big

@@ -126,21 +126,49 @@ def _lanes_to_limbs(lanes) -> Tuple[int, ...]:
     return tuple(out)
 
 
-def py_compress(state: Tuple[int, ...], block: bytes) -> Tuple[int, ...]:
-    """Absorb one rate block whose trailing PARAM_WORDS*4 bytes carry
-    the baked (t, f) parameter words — the packing-template form.
+def py_compress(state: Tuple[int, ...], block: bytes, *,
+                t: int | None = None,
+                last: bool | None = None) -> Tuple[int, ...]:
+    """Absorb one block.  Two accepted shapes (advisor r4 — every other
+    model's py_compress takes exactly BLOCK_BYTES, so a generic consumer
+    must be able to pass a plain block here too):
 
-    The generic host-absorption path never calls this for blake2
-    (py_absorb below owns prefix blocks with explicit parameters); this
-    entry exists for template-shaped blocks of
-    ``BLOCK_BYTES + 4 * PARAM_WORDS`` bytes.
+    * ``BLOCK_BYTES + 4 * PARAM_WORDS`` bytes — the packing-template
+      form, trailing bytes carrying the baked (t, f) parameter words;
+      ``t``/``last`` kwargs must not also be given.
+    * exactly ``BLOCK_BYTES`` — a plain block; ``t`` (total bytes
+      absorbed INCLUDING this block) is REQUIRED, because unlike every
+      other model blake2's compression is not a pure function of
+      (state, block): a silently-defaulted counter would chain
+      multi-block inputs into a wrong digest with no error (review
+      r5).  ``last`` defaults to False (non-final block).
     """
-    assert len(block) == BLOCK_BYTES + 4 * PARAM_WORDS
+    if len(block) == BLOCK_BYTES + 4 * PARAM_WORDS:
+        if t is not None or last is not None:
+            # TypeError (not assert): under python -O an assert would
+            # silently drop the caller's explicit counter in favor of
+            # the baked one — the silent-wrong-counter class the plain
+            # path's guard below exists to prevent (review r5)
+            raise TypeError(
+                "template-shaped block already carries baked (t, f) "
+                "parameter words; do not also pass t=/last="
+            )
+        t = int.from_bytes(block[128:136], "little")
+        last = int.from_bytes(block[136:144], "little") != 0
+    else:
+        assert len(block) == BLOCK_BYTES, len(block)
+        if t is None:
+            raise TypeError(
+                "blake2b py_compress needs t= (bytes absorbed including "
+                "this block) for a plain 128-byte block — the byte "
+                "counter is a compression input; use py_absorb for "
+                "prefix absorption, or pass the template-shaped block "
+                "(BLOCK_BYTES + 16) with baked parameters"
+            )
+        last = False if last is None else last
     h = _limbs_to_lanes(state, 8)
     m = [int.from_bytes(block[8 * i: 8 * i + 8], "little") for i in range(16)]
-    t = int.from_bytes(block[128:136], "little")
-    f = int.from_bytes(block[136:144], "little")
-    return _lanes_to_limbs(blake2b_f(h, m, t, f != 0))
+    return _lanes_to_limbs(blake2b_f(h, m, t, last))
 
 
 def py_absorb(prefix: bytes) -> Tuple[Tuple[int, ...], bytes, int]:

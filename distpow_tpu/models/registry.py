@@ -34,7 +34,14 @@ class HashModel:
     length_byteorder: str      # byte order of the 8-byte bit-length field
     init_state: Tuple[int, ...]
     compress: Callable         # (state, words[16]) -> state, vectorized JAX
-    py_compress: Callable      # pure-Python twin, for host-side absorption
+    # Pure-Python twin, for host-side absorption.  Contract: takes
+    # (state, block) with block of exactly BLOCK_BYTES — except models
+    # with block_param_words (blake2b), whose template-shaped blocks
+    # widen to BLOCK_BYTES + 4*param_words; their py_compress also
+    # accepts a plain BLOCK_BYTES block with an EXPLICIT t= byte
+    # counter (required — a defaulted counter would silently chain
+    # multi-block inputs wrong; advisor r4 + review r5).
+    py_compress: Callable
     py_absorb: Callable        # prefix -> (state, remainder, absorbed_len)
     # Measured compute cost: XLA cost_analysis() op count per hash on
     # the optimized difficulty<=8-nibble serving program (mask-word DCE

@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # One-command verification: native build, fast test suite, multichip
 # dryrun.  The full suite (incl. slow interpret-mode Pallas and
-# multi-process tests) is `pytest tests/ -q` (~15 min); this fast lane
-# is what a pre-commit check should run (~4 min).
-# Usage: scripts/ci.sh [--full]
+# multi-process tests, excl. the nightly veryslow tier — README Tests)
+# is `--full` (~10 min of pytest); this fast lane is what a pre-commit
+# check should run (~4 min).  `--nightly` adds the veryslow tier.
+# Usage: scripts/ci.sh [--full|--nightly]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -12,9 +13,11 @@ make -C distpow_tpu/backends/native
 
 echo "=== test suite ==="
 case "${1:-}" in
-  --full) python -m pytest tests/ -q ;;
-  "")     python -m pytest tests/ -q -m "not slow" ;;
-  *)      echo "unknown argument: $1 (usage: scripts/ci.sh [--full])" >&2
+  --nightly) python -m pytest tests/ -q ;;
+  --full) python -m pytest tests/ -q -m "not veryslow" ;;
+  "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
+  *)      echo "unknown argument: $1" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly]" >&2
           exit 2 ;;
 esac
 

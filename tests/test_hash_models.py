@@ -331,6 +331,48 @@ def test_sha3_jax_compress_batch_vs_hashlib():
     assert digest == hashlib.sha3_256(long_msg).digest()
 
 
+def test_blake2b_py_compress_accepts_plain_block():
+    """Generic-consumer contract (advisor r4 + review r5): a plain
+    BLOCK_BYTES block with an EXPLICIT byte counter must compress
+    identically to the template-shaped block carrying the same baked
+    parameters; omitting t raises a guiding TypeError instead of
+    silently chaining multi-block inputs into a wrong digest (blake2's
+    compression is not a pure function of (state, block))."""
+    import pytest as _pytest
+
+    from distpow_tpu.models import blake2b_py as b
+
+    state, rem, absorbed = b.py_absorb(b"")
+    assert rem == b"" and absorbed == 0
+    block = bytes(range(100)) + bytes(28)
+    params = (128).to_bytes(8, "little") + (0).to_bytes(8, "little")
+    assert (b.py_compress(state, block, t=128)
+            == b.py_compress(state, block + params))
+    # a final block via explicit kwargs
+    final_params = (100).to_bytes(8, "little") + \
+        (0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
+    assert (b.py_compress(state, block, t=100, last=True)
+            == b.py_compress(state, block + final_params))
+    # the plain non-final form agrees with py_absorb on real data
+    state2, _, absorbed2 = b.py_absorb(block + b"\x99")
+    assert absorbed2 == 128
+    assert tuple(b.py_compress(state, block, t=128)) == tuple(state2)
+    # ...and CHAINING with correct counters matches a 2-block absorb
+    block2 = bytes(range(50, 178))
+    state3, _, absorbed3 = b.py_absorb(block + block2 + b"\x77")
+    assert absorbed3 == 256
+    chained = b.py_compress(
+        b.py_compress(state, block, t=128), block2, t=256)
+    assert tuple(chained) == tuple(state3)
+    # omitted counter on a plain block: guided error, not wrong math
+    with _pytest.raises(TypeError, match="bytes absorbed"):
+        b.py_compress(state, block)
+    # the template form still rejects doubled parameters (TypeError,
+    # not assert: must survive python -O)
+    with _pytest.raises(TypeError, match="baked"):
+        b.py_compress(state, block + params, t=1)
+
+
 def test_blake2b_registry_and_params():
     """The per-block-parameter model's registry shape: blake2's byte
     counter and finalization flag are compression inputs the packing

@@ -467,11 +467,73 @@ def test_sha512_interpret_mode_falls_back():
             )
 
 
-@pytest.mark.slow
+def test_backend_batch_rounding_keeps_inner_for_24_sublane_tiles(monkeypatch):
+    """Serving-side support for the sweep-best sublanes=24 geometries
+    (VERDICT r4 item 8 / ROUND4 open edge): a 2^21 batch at tile 3072
+    is 683 tiles — prime — which would collapse the tuned inner to
+    unswept territory.  The factory must grow the batch by whole tiles
+    until the per-dispatch tile count divides inner, keeping chunk
+    accounting exact and the growth marginal."""
+    import math
+
+    from distpow_tpu.backends.pallas_backend import PallasBackend
+
+    captured = {}
+
+    def fake_step(nonce, vw, difficulty, tb_lo, tbc, chunks, mname,
+                  extra, sublanes, interpret, k, inner):
+        captured.update(chunks=chunks, k=k, tbc=tbc, sublanes=sublanes,
+                        inner=inner)
+        return lambda c0: 0
+
+    monkeypatch.setattr(
+        "distpow_tpu.backends.pallas_backend.cached_pallas_search_step",
+        fake_step)
+    b = PallasBackend(hash_model="ripemd160", batch_size=1 << 21,
+                      sublanes=24, inner=1024)
+    factory = b._factory(b"\x01\x02\x03\x04", 8, 0, 256)
+    step, covered = factory(4, b"", (1 << 21) // 256, launch_steps=128)
+
+    tile = 24 * 128
+    batch = captured["chunks"] * 256
+    assert batch % tile == 0, "not a whole tile grid"
+    n_tiles = batch // tile
+    k = captured["k"]
+    assert (n_tiles * k) % 1024 == 0, \
+        f"inner would shrink: {n_tiles} tiles x k={k} vs inner=1024"
+    # growth stays marginal (<= inner extra tiles; here well under 2%)
+    assert batch < (1 << 21) * 1.02
+    assert covered == captured["chunks"] * k
+    # power-of-two geometries are untouched by the rounding
+    captured.clear()
+    b2 = PallasBackend(hash_model="md5", batch_size=1 << 21)
+    f2 = b2._factory(b"\x01\x02\x03\x04", 8, 0, 256)
+    f2(4, b"", (1 << 21) // 256, launch_steps=8)
+    assert captured["chunks"] == (1 << 21) // 256
+    # and the no-op claim holds structurally: gcd math keeps pow2 counts
+    assert ((1 << 21) // (64 * 128) * 8) % b2.inner == 0 or \
+        math.gcd(8, b2.inner) == 8
+    # overgrowth is REJECTED (review r5: an uncapped version grew small
+    # segments 4x): a tiny k=1 launch at need=1024 would have to grow
+    # to 1024 tiles — far past the 2% cap — so the batch keeps the
+    # plain tile rounding and the kernel shrinks inner instead
+    captured.clear()
+    small = factory(4, b"", 1024, launch_steps=1)
+    batch_small = captured["chunks"] * 256
+    assert batch_small % tile == 0
+    assert batch_small <= 2 * 1024 * 256, \
+        f"small segment overgrown to {batch_small}"
+
+
+@pytest.mark.veryslow
 def test_sha256_pallas_kernel_matches_xla_step():
     """Full sha256 kernel in interpret mode (one compile ~80-160s on
-    XLA:CPU, hence one slow test; per-bucket hash correctness is covered
-    by the eager tile test above and the scaffold by the md5 tests).
+    XLA:CPU — the single biggest test in the suite, so it carries the
+    nightly-style ``veryslow`` marker, VERDICT r4 item 6; per-bucket
+    hash correctness is covered by the eager tile test above, the
+    scaffold by the md5 tests, and the compiled kernel by the hardware
+    parity artifacts under docs/artifacts/).  Run with
+    ``pytest -m veryslow`` before shipping kernel-scaffold changes.
     sublanes is pinned to 8: the serving default (16, MODEL_GEOMETRY)
     multiplies the interpret-mode compile severalfold, and tile
     correctness is geometry-independent."""

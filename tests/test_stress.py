@@ -17,6 +17,8 @@ import threading
 import time
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).parent))
 
 from test_nodes import Stack  # noqa: E402
@@ -105,6 +107,125 @@ def test_stress_trace_invariants_hold(tmp_path):
         server.close()
     assert check_trace_log(str(out)) == []
     assert check_shiviz_log(str(shiviz)) == []
+
+
+@pytest.mark.slow
+def test_mesh_worker_death_mid_solve_reassigned(tmp_path):
+    """Failure recovery composed with the MESH backends at the process
+    level (VERDICT r4 item 4): a pallas-mesh worker is SIGKILLed while
+    its first Mine is in flight (its interpret-mode launch is slow by
+    construction, so the kill deterministically lands mid-solve and its
+    cancel-acks are still outstanding); FailurePolicy="reassign" must
+    prune it, re-solve its shard through the surviving jax-mesh worker,
+    complete all four demo requests, and leave the trace oracle clean.
+    The reference errors out of the whole Mine in this situation
+    (/root/reference/coordinator.go:196-229)."""
+    import signal
+    import subprocess
+
+    from proc_harness import ProcStack
+
+    from distpow_tpu.cli.stats import fetch_stats
+
+    stack = ProcStack(
+        tmp_path, workers=2, seed=905,
+        coord_overrides={"FailurePolicy": "reassign",
+                         "FailureProbeSecs": 0.5},
+        # worker_config.json = the DOOMED pallas-mesh worker: interpret
+        # mode (no TPU in subprocesses) over a 4-device virtual CPU
+        # mesh; no warmup, so its first Mine pays the slow interpret
+        # launch and is guaranteed still in flight when we kill it
+        worker_overrides={"Backend": "pallas-mesh", "MeshDevices": 4,
+                          "PallasInterpret": True, "BatchSize": 1 << 14,
+                          "WarmupNonceLens": [], "WarmupWidths": []},
+    )
+    stack.env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # second config file for the SURVIVOR: the XLA mesh step over the
+    # same virtual mesh (fast on CPU) — "re-solved through a second
+    # mesh worker" needs both sides of the kill to be mesh backends
+    survivor_cfg = dict(stack.worker_cfg)
+    survivor_cfg.update({"Backend": "jax-mesh", "PallasInterpret": False})
+    (tmp_path / "worker_mesh2_config.json").write_text(
+        __import__("json").dumps(survivor_cfg))
+    try:
+        stack.boot_core()
+        doomed = stack.spawn(
+            "-m", "distpow_tpu.cli.worker",
+            "--config", stack.config("worker_config.json"),
+            "--id", "worker1", "--listen", stack.coord_cfg["Workers"][0],
+        )
+        stack.wait_for_line(doomed, "serving worker1 RPCs")
+        survivor = stack.spawn(
+            "-m", "distpow_tpu.cli.worker",
+            "--config", stack.config("worker_mesh2_config.json"),
+            "--id", "worker2", "--listen", stack.coord_cfg["Workers"][1],
+        )
+        stack.wait_for_line(survivor, "serving worker2 RPCs")
+
+        # difficulty 4 sizes the kill window: the doomed worker's
+        # interpret launches run ~4 s each (measured ~1 s per 4096
+        # candidates), so its first Mine is still mid-launch — acks
+        # outstanding — when the SIGKILL lands
+        client = stack.spawn(
+            "-m", "distpow_tpu.cli.client",
+            "--config", stack.config("client_config.json"),
+            "--config2", stack.config("client2_config.json"),
+            "--difficulty", "4",
+        )
+
+        # kill trigger: the doomed worker's own Stats counters prove a
+        # Mine is in flight on it (no fixed sleeps)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                snap = fetch_stats(stack.coord_cfg["Workers"][0],
+                                   role="worker", timeout=2.0)
+                if (snap["counters"].get("worker.mine_rpcs", 0) >= 1
+                        and snap["active_tasks"] >= 1):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        else:
+            raise AssertionError("doomed worker never received a Mine")
+        doomed.send_signal(signal.SIGKILL)
+
+        # every storm request still completes: the reap path prunes the
+        # dead mesh worker mid-flight and the survivor covers its shard
+        out, _ = client.communicate(timeout=180)
+        assert client.returncode == 0, out
+        assert out.count("MineResult") == 4, out
+        assert doomed.wait(timeout=10) == -signal.SIGKILL
+
+        # a FRESH post-kill nonce exercises the fan-out-into-the-corpse
+        # path deterministically: the dead worker's Mine send fails, is
+        # counted, and its shard is placed on (and re-solved by) the
+        # surviving mesh worker
+        from distpow_tpu.nodes.client import Client
+        from distpow_tpu.runtime.config import ClientConfig, read_json_config
+
+        late = Client(read_json_config(
+            stack.config("client_config.json"), ClientConfig))
+        late.config.ClientID = "client_late"
+        try:
+            late.initialize()
+            late.mine(bytes([0x91, 0x05]), 2)
+            res = late.notify_queue.get(timeout=120)
+            assert puzzle.check_secret(res.nonce, res.secret, 2)
+        finally:
+            late.close()
+
+        coord_snap = fetch_stats(
+            stack.coord_cfg["ClientAPIListenAddr"], role="coordinator",
+            timeout=5.0)
+        assert coord_snap["counters"].get("coord.worker_failures", 0) >= 1
+        assert coord_snap["counters"].get("coord.reassigned_shards", 0) >= 1
+    finally:
+        stack.close()
+        time.sleep(0.5)
+
+    assert check_trace_log(str(tmp_path / "trace_output.log")) == []
+    assert check_shiviz_log(str(tmp_path / "shiviz_output.log")) == []
 
 
 def test_stress_chaos_worker_death_reassign_journal(tmp_path):
