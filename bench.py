@@ -65,7 +65,7 @@ MD5_LABELS = ("serving", "xla-static", "pallas")
 
 # Registry models beyond md5, in bench order.
 OTHER_MODELS = ("sha256", "sha1", "ripemd160", "sha512", "sha384",
-                "sha3_256", "blake2b_256")
+                "sha3_256", "blake2b_256", "sha256d")
 
 # Serving steps whose loop form re-stacks state every round and lands
 # HBM-bound at single-digit MH/s (docs/KERNELS.md): their diagnostic

@@ -654,6 +654,38 @@ struct Blake2b256Traits {
   }
 };
 
+struct Sha256dTraits {
+  // Composed double SHA-256 (sha256d = sha256(sha256(m)), Bitcoin's
+  // PoW digest; ninth registry model, r5).  Absorption and padding are
+  // plain SHA-256 — the composition lives entirely in StoreDigest,
+  // which runs the fixed-layout second compression (digest ‖ 0x80 ‖
+  // zeros ‖ bit-length 256) before serializing, so the templated scan
+  // loop needs no new branch.
+  static constexpr int kBlockBytes = 64;
+  static constexpr int kLengthBytes = 8;
+  static constexpr int kStateWords = 8;
+  static constexpr int kDigestBytes = 32;
+  static constexpr bool kBigEndianLength = true;
+  static constexpr bool kSpongePadding = false;
+  static constexpr bool kNeedsBlockParams = false;
+  static const uint32_t* Init() { return kShaInit; }
+  static void Compress(uint32_t* state, const uint8_t* block) {
+    CompressSha256(state, block);
+  }
+  static void StoreDigest(const uint32_t* state, uint8_t* out) {
+    uint8_t block2[64];
+    Sha256Traits::StoreDigest(state, block2);  // first digest, BE words
+    block2[32] = 0x80;
+    std::memset(block2 + 33, 0, 29);
+    block2[62] = 0x01;  // 64-bit BE bit length = 256 = 0x0100
+    block2[63] = 0x00;
+    uint32_t st2[8];
+    std::memcpy(st2, kShaInit, sizeof(st2));
+    CompressSha256(st2, block2);
+    Sha256Traits::StoreDigest(st2, out);
+  }
+};
+
 // Trailing zero nibbles of the digest, scanned from the end: low nibble
 // of the last byte first (hex-string order).
 inline bool MeetsDifficulty(const uint8_t* digest, int digest_bytes,
@@ -898,7 +930,7 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
                          uint64_t chunk_count, int32_t n_threads,
                          const volatile int32_t* cancel_flag,
                          uint64_t* out_hashes, uint8_t* out_secret) {
-  if (n_tb == 0 || width > 8 || algo > 7) return -2;
+  if (n_tb == 0 || width > 8 || algo > 8) return -2;
   // a difficulty beyond the digest's nibble count would read past the
   // digest buffer in MeetsDifficulty (and the puzzle is unsatisfiable
   // anyway — the JAX paths reject it in nibble_masks)
@@ -910,7 +942,8 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
            : algo == 4 ? Sha512Traits::kDigestBytes
            : algo == 5 ? Sha384Traits::kDigestBytes
            : algo == 6 ? Sha3_256Traits::kDigestBytes
-                       : Blake2b256Traits::kDigestBytes);
+           : algo == 7 ? Blake2b256Traits::kDigestBytes
+                       : Sha256dTraits::kDigestBytes);
   if (difficulty > max_nibbles) return -2;
   SearchTask task{nonce,        nonce_len,  difficulty,
                   thread_bytes, n_tb,       width,
@@ -934,9 +967,12 @@ int distpow_search_range(const uint8_t* nonce, size_t nonce_len,
   } else if (algo == 6) {
     SearchRange<Sha3_256Traits>(task, chunk_count, n_threads, &found,
                                 &hashes);
-  } else {
+  } else if (algo == 7) {
     SearchRange<Blake2b256Traits>(task, chunk_count, n_threads, &found,
                                   &hashes);
+  } else {
+    SearchRange<Sha256dTraits>(task, chunk_count, n_threads, &found,
+                               &hashes);
   }
 
   if (out_hashes) *out_hashes = hashes;
@@ -984,6 +1020,10 @@ void distpow_sha3_256(const uint8_t* data, size_t len, uint8_t out[32]) {
 
 void distpow_blake2b_256(const uint8_t* data, size_t len, uint8_t out[32]) {
   DigestBuffer<Blake2b256Traits>(data, len, out);
+}
+
+void distpow_sha256d(const uint8_t* data, size_t len, uint8_t out[32]) {
+  DigestBuffer<Sha256dTraits>(data, len, out);
 }
 
 }  // extern "C"

@@ -100,12 +100,17 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib.distpow_blake2b_256.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.distpow_sha256d.restype = None
+        lib.distpow_sha256d.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
         _lib = lib
         return lib
 
 
 ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2, "ripemd160": 3,
-            "sha512": 4, "sha384": 5, "sha3_256": 6, "blake2b_256": 7}
+            "sha512": 4, "sha384": 5, "sha3_256": 6, "blake2b_256": 7,
+            "sha256d": 8}
 
 # Digest sizes (bytes) for the native algorithms, fixed by RFC 1321 /
 # FIPS 180-4.  max difficulty = hex nibbles = 2 * digest bytes; kept
@@ -114,7 +119,7 @@ ALGO_IDS = {"md5": 0, "sha256": 1, "sha1": 2, "ripemd160": 3,
 # max_difficulty via models.registry pulled jax into native-only use).
 DIGEST_BYTES = {"md5": 16, "sha256": 32, "sha1": 20, "ripemd160": 20,
                 "sha512": 64, "sha384": 48, "sha3_256": 32,
-                "blake2b_256": 32}
+                "blake2b_256": 32, "sha256d": 32}
 
 
 def native_md5(data: bytes) -> bytes:
@@ -170,6 +175,13 @@ def native_blake2b_256(data: bytes) -> bytes:
     lib = load_library()
     out = ctypes.create_string_buffer(32)
     lib.distpow_blake2b_256(data, len(data), out)
+    return out.raw
+
+
+def native_sha256d(data: bytes) -> bytes:
+    lib = load_library()
+    out = ctypes.create_string_buffer(32)
+    lib.distpow_sha256d(data, len(data), out)
     return out.raw
 
 

@@ -37,6 +37,30 @@ from typing import Callable, Iterator, Optional, Sequence, Tuple
 MAX_DIFFICULTY_MD5 = 32
 
 
+class _DoubleSha256:
+    """hashlib-shaped sha256(sha256(.)) — Bitcoin's PoW digest."""
+
+    name = "sha256d"
+    digest_size = 32
+
+    def __init__(self, data: bytes = b""):
+        self._inner = hashlib.sha256(data)
+
+    def update(self, data: bytes) -> None:
+        self._inner.update(data)
+
+    def digest(self) -> bytes:
+        return hashlib.sha256(self._inner.digest()).digest()
+
+    def hexdigest(self) -> str:
+        return self.digest().hex()
+
+    def copy(self) -> "_DoubleSha256":
+        c = _DoubleSha256()
+        c._inner = self._inner.copy()
+        return c
+
+
 def new_hash(algo: str):
     """``hashlib.new`` with a pure-Python fallback for ripemd160.
 
@@ -54,6 +78,11 @@ def new_hash(algo: str):
         # blake2b's digest size is a compression input (it XORs into
         # h[0]), so ``hashlib.new`` has no name for this variant
         return hashlib.blake2b(digest_size=32)
+    if algo == "sha256d":
+        # a COMPOSED hash (sha256 of sha256 — Bitcoin's PoW digest):
+        # hashlib has no name for it; this thin wrapper keeps the
+        # update/digest/hexdigest surface every caller here uses
+        return _DoubleSha256()
     try:
         return hashlib.new(algo)
     except ValueError:

@@ -20,6 +20,7 @@ from . import (
     sha1_jax,
     sha3_jax,
     sha256_jax,
+    sha256d_jax,
     sha384_jax,
     sha512_jax,
 )
@@ -76,6 +77,14 @@ class HashModel:
     # compression is purely (state, message).
     param_words: int = 0
     block_param_words: Callable = None
+    # Hash COMPOSITION (sha256d): an optional state -> state stage the
+    # search step applies after the last compress and before the
+    # difficulty check — e.g. a second full compression over the first
+    # digest.  Absorption/packing/partitioning never see it; the
+    # difficulty masks and digest serialization apply to the FINALIZED
+    # state.  ``py_finalize`` is the pure-Python twin for host oracles.
+    finalize: Callable = None
+    py_finalize: Callable = None
 
     @property
     def digest_bytes(self) -> int:
@@ -216,10 +225,29 @@ BLAKE2B_256 = HashModel(
     cost_ops=5205,
 )
 
+SHA256D = HashModel(
+    name="sha256d",
+    block_bytes=sha256d_jax.BLOCK_BYTES,
+    digest_words=sha256d_jax.DIGEST_WORDS,
+    word_byteorder=sha256d_jax.WORD_BYTEORDER,
+    length_byteorder=sha256d_jax.LENGTH_BYTEORDER,
+    init_state=sha256d_jax.SHA256_INIT,
+    compress=sha256_jax.sha256_compress,   # first stage = plain SHA-256
+    py_compress=sha256d_jax.py_compress,
+    py_absorb=sha256d_jax.py_absorb,
+    finalize=sha256d_jax.sha256d_finalize,  # second stage (composition)
+    py_finalize=sha256d_jax.py_finalize,
+    # derived from sha256's measured cost_analysis figures (same op
+    # counting as every model): first compression at FULL digest (3165
+    # — every word feeds stage 2, no DCE) + second compression at the
+    # serving mask bucket (2909)
+    cost_ops=6074,
+)
+
 _REGISTRY: Dict[str, HashModel] = {
     "md5": MD5, "sha256": SHA256, "sha1": SHA1, "ripemd160": RIPEMD160,
     "sha512": SHA512, "sha384": SHA384, "sha3_256": SHA3_256,
-    "blake2b_256": BLAKE2B_256,
+    "blake2b_256": BLAKE2B_256, "sha256d": SHA256D,
 }
 
 

@@ -100,8 +100,16 @@ def _compress_loop(state, words):
     """fori_loop form: rounds 0-15 unrolled on the RAW words (constant
     message words stay scalars XLA folds); rounds 16-63 carry a rolling
     16-word schedule WINDOW.  Compiles in ~1s everywhere — but on TPU
-    the window (16 batch-shaped arrays re-tupled per iteration) costs
-    real HBM traffic, so the serving path prefers the unrolled form."""
+    the window costs real HBM traffic, so the serving path prefers the
+    unrolled form.
+
+    The window is one stacked (16, *batch) array, not a tuple: under
+    ``shard_map`` some message words vary across the mesh axis and some
+    are replicated, and rotating a tuple would move a varying value
+    into a replicated slot — a carry-type mismatch the stack avoids by
+    unifying the axis-varying type at construction (the sha1 fix,
+    latent here until sha256d's mesh leg hit a layout whose trailing
+    window entries were all template constants, r5)."""
     ws = [_u32(m) for m in words]
     # include the STATE shapes: a tail block can be all-constant (the
     # padding/length block of a 2-block tail whose variable bytes all
@@ -114,7 +122,13 @@ def _compress_loop(state, words):
         st = _round(st, jnp.uint32(SHA256_K[i]), ws[i])
 
     K = _k_array()
-    window = tuple(jnp.broadcast_to(w, shape) for w in ws)
+    window = jnp.stack([jnp.broadcast_to(w, shape) for w in ws])
+    # varying-typed zero: the stacked window rows share the JOINT
+    # axis-varying type; adding it unifies the state words' types too
+    # (a state word fed only by replicated message words would
+    # otherwise flip to varying mid-loop as the rotation mixes them)
+    vzero = window[0] & jnp.uint32(0)
+    st = tuple(jnp.broadcast_to(s, shape) + vzero for s in st)
 
     def body(i, carry):
         st, win = carry
@@ -123,13 +137,9 @@ def _compress_loop(state, words):
         s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
         w_new = win[0] + s0 + w7 + s1
         st = _round(st, K[i], w_new)
-        return st, win[1:] + (w_new,)
+        return st, jnp.concatenate([win[1:], w_new[None]], axis=0)
 
-    st, _ = lax.fori_loop(
-        16, 64, body,
-        (tuple(jnp.broadcast_to(s, shape) for s in st), window),
-        unroll=4,
-    )
+    st, _ = lax.fori_loop(16, 64, body, (st, window), unroll=4)
     return tuple(_u32(s0) + s for s0, s in zip(state, st))
 
 

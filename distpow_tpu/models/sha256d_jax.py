@@ -1,0 +1,110 @@
+"""Double SHA-256 (sha256d) — Bitcoin's proof-of-work hash.
+
+The ninth registry model adds the one structural axis the first eight
+don't exercise: **hash composition**.  ``sha256d(m) =
+sha256(sha256(m))`` — the first hash's 32-byte digest becomes the
+message of a second SHA-256 whose layout is FIXED (one 64-byte block:
+digest ‖ 0x80 ‖ zeros ‖ bit-length 256), independent of the search
+candidate.  That second stage rides the registry's ``finalize`` hook
+(models/registry.py): absorption, packing, partitioning, and the
+layout-keyed compile discipline are all untouched — the composed stage
+is a pure state→state function applied after the last compress, before
+the difficulty check.
+
+Reference role: the pluggable hash-kernel contract
+(/root/reference/worker.go:353-356 — the reference hard-codes one
+``md5.Sum``; this framework treats the kernel as a plug, and sha256d
+shows a composed real-world kernel plugging in).
+
+Everything SHA-256 (block geometry, byte orders, init state, compress,
+python twins for absorption) is reused from models/sha256_jax.py; this
+module adds only the composition stage and its twins.
+
+Mask-word DCE composes for free: difficulty masks touch the SECOND
+hash's trailing digest words, so XLA (and the Pallas tile's explicit
+A/E-chain pruning) drops the unused tail of the second compression,
+while the first compression always computes its full digest (every
+word feeds the second message).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .sha256_jax import (
+    BLOCK_BYTES,
+    DIGEST_WORDS,
+    LENGTH_BYTEORDER,
+    SHA256_INIT,
+    WORD_BYTEORDER,
+    py_absorb,
+    py_compress,
+    sha256_compress,
+)
+
+__all__ = [
+    "BLOCK_BYTES", "DIGEST_WORDS", "LENGTH_BYTEORDER", "WORD_BYTEORDER",
+    "SHA256_INIT", "py_absorb", "py_compress", "sha256d_finalize",
+    "py_finalize", "SECOND_BLOCK_TAIL_WORDS",
+]
+
+# The second block's non-digest words: 0x80 padding marker directly
+# after the 32 digest bytes, zeros, and the 64-bit big-endian
+# bit-length field (32 bytes = 256 bits) — fixed by FIPS 180-4 for a
+# 32-byte single-block message.
+SECOND_BLOCK_TAIL_WORDS: Tuple[int, ...] = (
+    0x80000000, 0, 0, 0, 0, 0, 0, 256,
+)
+
+
+def sha256d_finalize(state):
+    """Second SHA-256 over the first digest, vectorized.
+
+    ``state`` is the first compression's 8-word output (arrays over the
+    candidate batch).  Because WORD_BYTEORDER is big-endian for both
+    the digest serialization and the message-word packing, the second
+    block's first 8 message words ARE the first hash's state words —
+    no byte swapping.
+
+    shard_map varying-axis typing: the second compression starts from
+    the constant SHA256_INIT and half its message words are constants;
+    on backends using the fori_loop compress form the rolling window
+    carry would flip varying mid-loop (the exact class the blake2b r5
+    dryrun leg caught).  A varying-typed zero derived from the incoming
+    state is XOR'd into every constant entering the stage — value-free
+    after XLA folding, but the carry's varying type is uniform from
+    round 0.
+    """
+    s = [jnp.asarray(w, jnp.uint32) for w in state[:DIGEST_WORDS]]
+    vz = s[0] & jnp.uint32(0)
+    words = s + [jnp.uint32(c) ^ vz for c in SECOND_BLOCK_TAIL_WORDS]
+    init2 = tuple(jnp.uint32(c) ^ vz for c in SHA256_INIT)
+    return sha256_compress(init2, words)
+
+
+def py_finalize(state: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Pure-Python twin of ``sha256d_finalize`` (host-side oracle)."""
+    digest = b"".join(int(w).to_bytes(4, "big") for w in state[:DIGEST_WORDS])
+    block = digest + b"\x80" + bytes(23) + (8 * len(digest)).to_bytes(8, "big")
+    assert len(block) == BLOCK_BYTES
+    return py_compress(SHA256_INIT, block)
+
+
+def py_digest(message: bytes) -> bytes:
+    """Full sha256d over ``message`` via the state-level twins — the
+    hashlib-parity surface test_hash_models exercises per model.
+
+    The first hash reuses sha256_jax's own ``py_digest`` (one canonical
+    FIPS 180-4 padding implementation, review r5); its digest bytes ARE
+    the first state big-endian, so re-unpacking them feeds the real
+    ``py_finalize`` composition stage this module owns."""
+    import struct
+
+    from .sha256_jax import py_digest as _sha256_py_digest
+
+    state = struct.unpack(">8I", _sha256_py_digest(message))
+    return b"".join(
+        int(w).to_bytes(4, "big") for w in py_finalize(state)
+    )

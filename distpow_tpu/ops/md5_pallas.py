@@ -69,7 +69,7 @@ from ..models.ripemd160_py import _RR as RMD_RR
 from ..models.ripemd160_py import _SL as RMD_SL
 from ..models.ripemd160_py import _SR as RMD_SR
 from ..models.sha1_jax import SHA1_K
-from ..models.sha256_jax import SHA256_K
+from ..models.sha256_jax import SHA256_INIT, SHA256_K
 from .difficulty import nibble_masks
 from .packing import build_tail_spec
 from .search_step import SENTINEL, _check_launch, mask_words_for
@@ -125,7 +125,15 @@ MODEL_GEOMETRY = {"md5": (64, 512), "sha256": (32, 256),
                   # (24, 1024) at 977.4 is again not pow2-compatible).
                   # Unlike keccak it prefers TALLER tiles — the v
                   # working set is half the sponge state's
-                  "blake2b_256": (32, 128)}
+                  "blake2b_256": (32, 128),
+                  # composed double-sha256 (r5 ninth model): starts on
+                  # sha256's swept geometry — the live set is one
+                  # sha256 chain at a time (stage 2 starts after stage
+                  # 1's digest collapses to 8 words), so the same
+                  # height should hold; hardware sweep queued
+                  # (scripts/tpu_session_r5b.sh — r5.sh was already
+                  # armed when the model landed)
+                  "sha256d": (32, 256)}
 _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 
 # Models whose tile only serves on REAL TPU hardware: interpret mode
@@ -135,7 +143,11 @@ _I32_MISS = 0x7FFFFFFF  # in-kernel miss marker (int32 reduction domain)
 # ValueError for these under interpret=True and callers fall back to
 # the fused XLA step, exactly like a model with no tile at all.
 INTERPRET_XLA_FALLBACK = frozenset(
-    {"sha512", "sha384", "sha3_256", "blake2b_256"})
+    {"sha512", "sha384", "sha3_256", "blake2b_256",
+     # the composed tile doubles sha256's unrolled graph — whose
+     # single copy already does not terminate in XLA:CPU codegen at
+     # serving height (models/sha256_jax.py platform note)
+     "sha256d"})
 
 
 def default_geometry(model_name: str, interpret: bool = False):
@@ -287,6 +299,27 @@ def _sha256_tile(words, init, mask_words: int = 8):
         else:
             out.append(init[j] + (A[63 - j] if j < 4 else E[67 - j]))
     return tuple(out)
+
+
+def _sha256d_tile(words, init, mask_words: int = 8):
+    """Composed double-SHA-256 tile: sha256d(m) = sha256(sha256(m)).
+
+    Stage 1 is the plain SHA-256 tile at FULL digest width (every word
+    feeds stage 2, so no DCE there); stage 2 hashes the fixed-layout
+    second block — digest words ‖ 0x80 marker ‖ zeros ‖ bit-length 256
+    (models/sha256d_jax.py SECOND_BLOCK_TAIL_WORDS) — from the constant
+    SHA-256 init, with the difficulty-bucket DCE applied to ITS trailing
+    chains (mask_words_for semantics compose through unchanged).  The
+    word byteorder is big-endian on both sides, so stage 1's digest
+    words are stage 2's message words verbatim.
+    """
+    d = _sha256_tile(words, init, mask_words=8)
+    # uint32-wrap the marker word: 0x80000000 as a bare python int
+    # overflows int32 argument parsing in the schedule adds
+    second = list(d) + [jnp.uint32(c)
+                        for c in (0x80000000, 0, 0, 0, 0, 0, 0, 256)]
+    init2 = tuple(jnp.uint32(c) for c in SHA256_INIT)
+    return _sha256_tile(second, init2, mask_words=mask_words)
 
 
 def _sha1_tile(words, init, mask_words: int = 5):
@@ -655,7 +688,8 @@ _TILE_FNS = {"md5": (_md5_tile, 4, 4, 16), "sha256": (_sha256_tile, 8, 8, 16),
              "sha384": (_sha384_tile, 16, 12, 32),
              "sha3_256": (_sha3_tile, 50, 8, 34),
              # 36 = 32 message limbs + 4 baked parameter limbs
-             "blake2b_256": (_blake2b_tile, 16, 8, 36)}
+             "blake2b_256": (_blake2b_tile, 16, 8, 36),
+             "sha256d": (_sha256d_tile, 8, 8, 16)}
 assert set(_TILE_FNS) == set(MODEL_GEOMETRY), \
     "every pallas kernel model needs a MODEL_GEOMETRY entry and vice versa"
 

@@ -59,6 +59,8 @@ def _eval_candidates(spec: TailSpec, masks, model: HashModel, tb, chunk):
     for b in range(spec.n_blocks):
         words = make_words(spec, tb, chunk)[b]
         state = model.compress(state, words)
+    if model.finalize is not None:  # composed hashes (sha256d)
+        state = model.finalize(state)
     return meets_difficulty(state, masks)
 
 
@@ -144,6 +146,8 @@ def eval_dyn_candidates(model, n_blocks, tb_loc, chunk_locs, init, base, tb, chu
                 byte_j = (chunk >> jnp.uint32(8 * j)) & jnp.uint32(0xFF)
                 words[cw] = words[cw] | (byte_j << cs)
         state = model.compress(state, words)
+    if model.finalize is not None:  # composed hashes (sha256d)
+        state = model.finalize(state)
     return state
 
 

@@ -15,7 +15,8 @@ load unchanged.  TPU-specific extensions are additive with defaults:
 * ``WorkerConfig.HashModel`` — any registry model
   (models/registry.py): ``md5`` (reference parity, default),
   ``sha256`` (north-star variant), ``sha1``, ``ripemd160``,
-  ``sha512``, ``sha384``, ``sha3_256``, or ``blake2b_256``.
+  ``sha512``, ``sha384``, ``sha3_256``, ``blake2b_256``, or
+  ``sha256d`` (double SHA-256, Bitcoin's PoW digest).
 * ``WorkerConfig.BatchSize`` — candidates per device launch.
 
 Unknown JSON fields are ignored (forward compatibility); missing fields

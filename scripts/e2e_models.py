@@ -25,7 +25,8 @@ sys.path.insert(0, ".")
 # difficulty per model targeting ~0.3-1 s/solve at the measured rates
 # (docs/KERNELS.md standing table)
 DIFFICULTY = {"md5": 8, "sha1": 8, "sha256": 7, "ripemd160": 7,
-              "sha512": 7, "sha384": 7, "sha3_256": 7, "blake2b_256": 7}
+              "sha512": 7, "sha384": 7, "sha3_256": 7, "blake2b_256": 7,
+              "sha256d": 7}
 
 
 def main() -> None:

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Round-5 follow-up hardware batch: the ninth model (sha256d, composed
+# double SHA-256) landed after scripts/tpu_session_r5.sh was already
+# armed, and a RUNNING bash script must not be edited in place (bash
+# reads by file offset).  This batch adds sha256d's hardware evidence:
+# geometry sweep + a bench refresh (bench.py's model loop already
+# includes sha256d, so the refresh lands its serving + kernel lines
+# into last_measured.json).  Run AFTER the main r5 session completes.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-docs/artifacts/r5}"
+mkdir -p "$OUT"
+LOG="$OUT/session_b.log"
+
+note() { echo "[$(date +%T)] $*" | tee -a "$LOG"; }
+
+wait_device() {
+  for i in $(seq 1 "${1:-200}"); do
+    timeout 150 python -c \
+      "import jax, jax.numpy as jnp; assert int(jnp.uint32(2)+jnp.uint32(3))==5" \
+      2>"$OUT/probe_b.err" && { note "device up"; return 0; }
+    local rc=$?
+    if [ "$rc" -ne 124 ] && [ "$rc" -ne 143 ] && [ "$rc" -ne 1 ]; then
+      note "probe CRASHED (rc=$rc), aborting"; exit 1
+    fi
+    sleep 90
+  done
+  note "device never appeared"; return 1
+}
+
+note "r5b session start"
+wait_device 200 || exit 1
+
+note "=== sha256d kernel geometry sweep ==="
+timeout 2400 python scripts/sweep_sha256_pallas.py --model sha256d \
+  >"$OUT/sweep_sha256d.log" 2>&1
+note "sweep rc=$?"
+tail -6 "$OUT/sweep_sha256d.log" | tee -a "$LOG"
+wait_device 200 || exit 1
+
+note "=== bench refresh (sha256d lines) ==="
+timeout 1500 python bench.py >"$OUT/bench4.json" 2>"$OUT/bench4.log"
+note "bench4 rc=$?"
+cat "$OUT/bench4.json" | tee -a "$LOG"
+
+note "=== sha256d hardware parity ==="
+timeout 1200 python scripts/check_pallas_parity.py sha256d \
+  >"$OUT/parity_sha256d.log" 2>&1
+note "parity rc=$?"
+tail -3 "$OUT/parity_sha256d.log" | tee -a "$LOG"
+
+note "r5b session done"

@@ -15,6 +15,7 @@ from distpow_tpu.models import (
     sha1_jax,
     sha3_jax,
     sha256_jax,
+    sha256d_jax,
     sha384_jax,
     sha512_jax,
 )
@@ -25,6 +26,7 @@ from distpow_tpu.models.registry import (
     SHA1,
     SHA3_256,
     SHA256,
+    SHA256D,
     SHA384,
     SHA512,
     get_hash_model,
@@ -108,6 +110,8 @@ def test_md5_jax_vectorized_batch():
     (SHA384, hashlib.sha384),
     (SHA3_256, hashlib.sha3_256),
     (BLAKE2B_256, lambda m: hashlib.blake2b(m, digest_size=32)),
+    (SHA256D,
+     lambda m: hashlib.sha256(hashlib.sha256(m).digest())),
 ])
 @pytest.mark.parametrize("length", [0, 5, 63, 64, 70, 128, 129, 135, 136, 137])
 def test_py_twins_vs_hashlib(model, href, length):
@@ -116,7 +120,7 @@ def test_py_twins_vs_hashlib(model, href, length):
     mod = {MD5: md5_jax, SHA256: sha256_jax, SHA1: sha1_jax,
            RIPEMD160: ripemd160_jax, SHA512: sha512_jax,
            SHA384: sha384_jax, SHA3_256: sha3_jax,
-           BLAKE2B_256: blake2b_jax}[model]
+           BLAKE2B_256: blake2b_jax, SHA256D: sha256d_jax}[model]
     assert mod.py_digest(msg) == href(msg).digest()
 
 
@@ -329,6 +333,65 @@ def test_sha3_jax_compress_batch_vs_hashlib():
     st = sha3_jax.sha3_256_compress(st, struct.unpack("<34I", bytes(t)))
     digest = b"".join(int(w).to_bytes(4, "little") for w in st[:8])
     assert digest == hashlib.sha3_256(long_msg).digest()
+
+
+def test_sha256d_registry_and_finalize():
+    """The composed model's registry shape (r5 ninth model): sha256d
+    is plain SHA-256 absorption plus a ``finalize`` composition stage
+    — the structural axis no other model exercises.  The vectorized
+    finalize and its python twin must agree with hashlib's double
+    digest, and the serving path must apply it (a cached step at
+    difficulty 1 agrees with the double-hash oracle)."""
+    import hashlib
+
+    m = get_hash_model("sha256d")
+    assert m is SHA256D
+    assert m.finalize is sha256d_jax.sha256d_finalize
+    assert m.py_finalize is sha256d_jax.py_finalize
+    assert m.compress is sha256_jax.sha256_compress
+    assert m.max_difficulty == 64 and m.digest_words == 8
+
+    # vectorized finalize == python twin == hashlib, over a small batch
+    msgs = [bytes([i]) * 11 for i in range(4)]
+    states = [sha256_jax.py_absorb(b"")[0] for _ in msgs]
+    firsts = []
+    for msg, st in zip(msgs, states):
+        padded = (msg + b"\x80" + bytes((55 - len(msg)) % 64)
+                  + (8 * len(msg)).to_bytes(8, "big"))
+        for i in range(0, len(padded), 64):
+            st = sha256_jax.py_compress(st, padded[i:i + 64])
+        firsts.append(st)
+    batch = tuple(
+        jnp.asarray(np.array([f[w] for f in firsts], np.uint32))
+        for w in range(8)
+    )
+    out = sha256d_jax.sha256d_finalize(batch)
+    for i, msg in enumerate(msgs):
+        want = hashlib.sha256(hashlib.sha256(msg).digest()).digest()
+        got = b"".join(int(w[i]).to_bytes(4, "big") for w in out)
+        assert got == want
+        assert m.state_to_digest(sha256d_jax.py_finalize(firsts[i])) == want
+
+    # the serving (dyn) path applies finalize: first hit at difficulty
+    # 1 matches the double-hash oracle exactly
+    from distpow_tpu.models import puzzle
+    from distpow_tpu.ops.search_step import SENTINEL, cached_search_step
+
+    nonce = b"\x09\x08\x07"
+    step = cached_search_step(nonce, 1, 1, 0, 256, 64, "sha256d")
+    got_f = int(step(jnp.uint32(0)))
+    assert got_f != SENTINEL
+    # brute oracle over the same window
+    want_f = None
+    for f in range(64 * 256):
+        chunk, tb = f // 256, f % 256
+        secret = bytes([tb, chunk & 0xFF])
+        h = puzzle.new_hash("sha256d")
+        h.update(nonce + secret)
+        if h.hexdigest().endswith("0"):
+            want_f = f
+            break
+    assert got_f == want_f
 
 
 def test_blake2b_py_compress_accepts_plain_block():

@@ -187,6 +187,32 @@ def test_native_backend_blake2b_matches_oracle():
         assert backend.search(nonce, 2, list(range(256))) == oracle
 
 
+@pytest.mark.parametrize("length", [0, 5, 55, 56, 63, 64, 70, 128])
+def test_native_sha256d_vs_hashlib(length):
+    """Sha256dTraits digest hook (r5 ninth model): the composition
+    lives entirely in StoreDigest, so the fixed second-block layout
+    (0x80 at byte 32, zeros, BE bit-length 256 at bytes 56-63) is the
+    hand-written part to pin against hashlib's double digest."""
+    import random
+
+    rng = random.Random(9000 + length)
+    data = bytes(rng.randrange(256) for _ in range(length))
+    assert native.native_sha256d(data) == hashlib.sha256(
+        hashlib.sha256(data).digest()).digest()
+
+
+def test_native_backend_sha256d_matches_oracle():
+    """The composed trait through the generic scan loop: absorption is
+    plain SHA-256, the second compression happens at digest time."""
+    backend = native.NativeBackend(hash_model="sha256d", n_threads=1)
+    for nonce in (b"\x01\x02\x03\x04", bytes(range(70))):
+        for difficulty in (1, 2, 3):
+            tbs = list(range(256))
+            secret = backend.search(nonce, difficulty, tbs)
+            assert secret == puzzle.python_search(
+                nonce, difficulty, tbs, algo="sha256d")
+
+
 def test_native_backend_sha1_matches_oracle():
     """Sha1Traits through the same templated scan loop: reference
     enumeration order for the third registry model too."""

@@ -467,6 +467,65 @@ def test_sha512_interpret_mode_falls_back():
             )
 
 
+def test_sha256d_tile_matches_hashlib_all_buckets():
+    """Composed double-sha256 tile (r5 ninth model): eager tile math
+    vs hashlib's double digest at every mask-word bucket; the None-DCE
+    contract holds on the SECOND stage's dead words while stage 1 runs
+    full-width underneath."""
+    import hashlib
+    import struct
+
+    import numpy as np
+
+    from distpow_tpu.models.sha256_jax import SHA256_INIT
+    from distpow_tpu.ops.md5_pallas import _sha256d_tile
+
+    msgs = [bytes([i, (7 * i) & 0xFF, 3]) + b"abc" for i in range(8)]
+
+    def block_words(m):
+        block = (m + b"\x80" + bytes(64 - len(m) - 1 - 8)
+                 + (8 * len(m)).to_bytes(8, "big"))
+        return struct.unpack(">16I", block)
+
+    cols = [
+        jnp.asarray(np.array([block_words(m)[g] for m in msgs], np.uint32))
+        for g in range(16)
+    ]
+    init = tuple(jnp.uint32(c) for c in SHA256_INIT)
+    refs = [
+        struct.unpack(
+            ">8I", hashlib.sha256(hashlib.sha256(m).digest()).digest())
+        for m in msgs
+    ]
+    for mw in (1, 2, 4, 5, 8):
+        out = _sha256d_tile(cols, init, mw)
+        for j in range(8):
+            if j < 8 - mw:
+                assert out[j] is None
+            else:
+                for i, r in enumerate(refs):
+                    assert int(out[j][i]) == r[j], (mw, j, i)
+
+
+def test_sha256d_interpret_falls_back():
+    """Off-TPU the composed tile is kernel-unavailable by design (the
+    doubled unrolled graph is pathological for XLA:CPU codegen): the
+    builder refuses interpret mode and the backend transparently serves
+    the fused XLA step instead."""
+    from distpow_tpu.backends.pallas_backend import PallasBackend
+    from distpow_tpu.models import puzzle
+
+    with pytest.raises(ValueError, match="TPU-only"):
+        build_pallas_search_step(
+            b"\x01", 1, 2, 0, 256, 128, model_name="sha256d",
+            interpret=True)
+    b = PallasBackend(hash_model="sha256d", interpret=True,
+                      batch_size=1 << 12)
+    secret = b.search(b"\x05\x06\x07", 2, list(range(256)))
+    assert secret is not None
+    assert puzzle.check_secret(b"\x05\x06\x07", secret, 2, "sha256d")
+
+
 def test_backend_batch_rounding_keeps_inner_for_24_sublane_tiles(monkeypatch):
     """Serving-side support for the sweep-best sublanes=24 geometries
     (VERDICT r4 item 8 / ROUND4 open edge): a 2^21 batch at tile 3072

@@ -233,7 +233,11 @@ def test_mesh_search_new_models():
     mesh = make_mesh(jax.devices())
     tbs = list(range(256))
     for model, algo in ((RIPEMD160, "ripemd160"), (SHA512, "sha512"),
-                        (get_hash_model("blake2b_256"), "blake2b_256")):
+                        (get_hash_model("blake2b_256"), "blake2b_256"),
+                        # composed finalize under shard_map: the second
+                        # compression's constant init/message words are
+                        # varying-promoted (sha256d_jax.sha256d_finalize)
+                        (get_hash_model("sha256d"), "sha256d")):
         oracle = puzzle.python_search(b"\x0a\x0b", 2, tbs, algo=algo)
         got = search_mesh(b"\x0a\x0b", 2, tbs, model=model, mesh=mesh,
                           batch_size=1 << 13)
@@ -549,11 +553,14 @@ def _fuzz_schedules():
         SHA512,
     )
 
+    from distpow_tpu.models.registry import SHA256D
+
     return (
         (MD5, "md5", 3, 3), (SHA1, "sha1", 3, 3),
         (SHA256, "sha256", 3, 3), (RIPEMD160, "ripemd160", 3, 3),
         (SHA512, "sha512", 2, 2), (SHA384, "sha384", 1, 2),
         (SHA3_256, "sha3_256", 0, 2), (BLAKE2B_256, "blake2b_256", 0, 2),
+        (SHA256D, "sha256d", 2, 3),
     )
 
 
