@@ -1,6 +1,14 @@
 """Sweep a Pallas hash-kernel tile geometry on the real chip.
 
 Usage: python scripts/sweep_sha256_pallas.py [--quick] [--model NAME]
+                                             [--no-xla-ref]
+
+``--no-xla-ref`` skips the XLA serving reference compile: for a model
+whose fused-step compile cost is UNKNOWN (sha256d's doubled unrolled
+graph, r5), the reference is a gamble that could eat the whole tunnel
+window before any geometry row lands — the kernel table is this
+script's primary artifact, and the serving rate can come from a bench
+run instead (review r5).
 
 Measures candidates/sec for (sublanes, inner) combinations at the
 serving launch shape (width-4 chunks, full 256-byte partition,
@@ -71,6 +79,10 @@ def main() -> None:
         # to close (see the constant's docstring)
         print(f"[sweep] skipping XLA reference for {model} "
               f"(serving-step compile impractical)", file=sys.stderr)
+        xla = None
+    elif "--no-xla-ref" in sys.argv:
+        print(f"[sweep] skipping XLA reference for {model} "
+              f"(--no-xla-ref)", file=sys.stderr)
         xla = None
     else:
         def xla_builder():

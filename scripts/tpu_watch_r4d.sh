@@ -11,11 +11,16 @@ cd "$(dirname "$0")/.."
 
 OUT="${1:-docs/artifacts/r4d}"
 mkdir -p "$OUT"
+# probe stderr goes to SCRATCH, not the artifact dir: a long-lived
+# watcher re-dirties committed provenance on every probe otherwise
+# (review r5 — this watcher ran into round 5 and overwrote the r4d
+# probe record)
+PROBE_ERR="$(mktemp /tmp/r4d_probe.XXXXXX.err)"
 
 echo "=== waiting for device ($(date +%T)) ===" | tee "$OUT/session.log"
 UP=0
 for i in $(seq 1 400); do
-  timeout 150 python -c "import jax, jax.numpy as jnp; assert int(jnp.uint32(2)+jnp.uint32(3))==5" 2>"$OUT/probe.err"
+  timeout 150 python -c "import jax, jax.numpy as jnp; assert int(jnp.uint32(2)+jnp.uint32(3))==5" 2>"$PROBE_ERR"
   RC=$?
   if [ "$RC" -eq 0 ]; then
     echo "device up at $(date +%T)" | tee -a "$OUT/session.log"
@@ -24,7 +29,7 @@ for i in $(seq 1 400); do
   elif [ "$RC" -ne 124 ] && [ "$RC" -ne 143 ]; then
     echo "probe CRASHED (rc=$RC) — broken environment, aborting:" \
       | tee -a "$OUT/session.log"
-    tail -5 "$OUT/probe.err" | tee -a "$OUT/session.log"
+    tail -5 "$PROBE_ERR" | tee -a "$OUT/session.log"
     exit 1
   fi
   sleep 90

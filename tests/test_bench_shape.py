@@ -195,6 +195,12 @@ def test_stage_order_production_before_diagnostics(bench):
     assert "blake2b_256" in bench.OTHER_MODELS
     assert "blake2b_256" in bench.HBM_BOUND_SERVING
     assert "sha3_256" in bench.HBM_BOUND_SERVING
+    # sha256d rides Phase E right after the capped HBM lines: its
+    # first serving compile is the only unknown-cost one, so it must
+    # run while the deadline still admits it (and its grace-expiry
+    # path is the salvaging hang bailout, not a lost run)
+    assert "sha256d" in bench.OTHER_MODELS
+    assert '("sha256d",)' in src[phase_e:]
     # sha512/sha384 serving stays impossible-by-construction
     from distpow_tpu.ops.search_step import XLA_SERVING_COMPILE_IMPRACTICAL
 
