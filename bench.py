@@ -181,6 +181,14 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
         "value": round(measured_best, 3),
         "unit": "MH/s",
         "vs_baseline": vs,
+        # the driver records this stdout line as BENCH_r{N}.json: the
+        # per-stage rates measured THIS run ride along so the registry
+        # standing is in the round artifact itself, not only in the
+        # provenance file (VERDICT r4 item 1's Done criterion).  Values
+        # here are the honest measurements (suspect ones are flagged
+        # below, and the provenance file carries the screened view);
+        # stages not measured this run are absent — never stale.
+        "rates_mhs": {l: round(v, 2) for l, v in measured_mhs.items()},
     }
     if suspect:
         line["suspect_readings"] = suspect

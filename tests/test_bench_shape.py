@@ -136,6 +136,10 @@ def test_finalize_suspect_headline_protects_provenance(bench):
     line, prov = bench.finalize_record(rates_hs, LAST_FULL, 5.35e6)
     assert "suspect" in line["metric"]
     assert line["value"] in (80.0, 82.0)
+    # line rates stay the honest measurements (flagged via
+    # suspect_readings); the screened standing lives in provenance
+    assert line["rates_mhs"]["serving"] == 80.0
+    assert prov["rates_mhs"]["serving"] == 9766.8
     assert prov["value"] == prov["rates_mhs"][
         "serving" if "serving path" in line["metric"] else "xla-static"]
     # provenance headline = previous standing, not the degraded reading
@@ -153,8 +157,12 @@ def test_finalize_carried_forward_is_explicit(bench):
     assert prov["rates_mhs"]["blake2b_256-pallas"] == 974.9
     assert set(prov["carried_forward"]) == {"xla-static", "sha1-pallas",
                                             "blake2b_256-pallas"}
-    # the stdout line never carries stale rates at all
+    # the stdout line never carries stale rates at all: only the stages
+    # measured THIS run appear in its rates_mhs (the round artifact the
+    # driver records), with their honest values
     assert "carried_forward" not in line
+    assert set(line["rates_mhs"]) == {"serving", "pallas"}
+    assert line["rates_mhs"]["serving"] == 9800.0
 
 
 def test_finalize_bailout_note_and_no_baseline(bench):
