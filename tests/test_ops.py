@@ -55,6 +55,28 @@ def test_packing_matches_hashlib(model, nonce_len, width):
         assert digest_of(spec, model, tb, chunk) == expect.digest()
 
 
+def test_sha256d_tail_spec_identical_to_sha256():
+    """Composition must not leak into packing: sha256d's tail spec is
+    byte-identical to sha256's at every layout (same padding family,
+    block geometry, byte orders, init state) — the finalize stage is
+    the ONLY difference between the two models' device programs."""
+    from distpow_tpu.models.registry import get_hash_model
+
+    sha256d = get_hash_model("sha256d")
+    rng = random.Random(0xD0)
+    for nonce_len in (0, 4, 55, 64, 70, 130):
+        nonce = bytes(rng.randrange(256) for _ in range(nonce_len))
+        for width in (0, 2, 4):
+            a = build_tail_spec(nonce, width, SHA256)
+            b = build_tail_spec(nonce, width, sha256d)
+            assert a.init_state == b.init_state
+            assert a.n_blocks == b.n_blocks
+            assert a.base_words == b.base_words
+            assert a.tb_loc == b.tb_loc and a.chunk_locs == b.chunk_locs
+    # ...and the composed digest check itself is pinned elsewhere
+    # (test_hash_models.test_sha256d_registry_and_finalize, the fuzz)
+
+
 def test_packing_extra_const_chunk():
     # width > 4 support: high chunk bytes folded into the constant template
     nonce = b"\x01\x02\x03\x04"
