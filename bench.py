@@ -23,7 +23,7 @@ tail, never the registry's standing —
 
   A. md5 headline (serving / xla-static / pallas)
   B. every other model's PRODUCTION path (the Pallas kernel a TPU
-     config actually serves) — all eight models land here
+     config actually serves) — the whole registry lands here
   C. anchors: measured VPU roofline + native CPU baselines
   D. e2e wall-clock solves (deadline-gated)
   E. diagnostic XLA serving lines, HBM-bound ones budget-capped from
