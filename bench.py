@@ -142,11 +142,31 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
       a bare merge made stale values indistinguishable from fresh ones
       under the new date/run_id).
 
-    Requires at least one md5 label in ``rates_hs``.
+    With no md5 label in ``rates_hs`` (the device hung before Phase A
+    produced one) the device-hung-shaped line is returned instead of
+    crashing on ``max`` over an empty pool (advisor r5 low #3 — the
+    hang bailout guards this case itself, but main()'s final call
+    relied on Phase A's unguarded serving stage crashing first).  The
+    provenance half is None in that case: a run that measured no md5
+    stage must not overwrite last_measured.json with a zero record
+    (the pre-guard crash at least left provenance intact).
     """
     measured_mhs = {l: v / 1e6 for l, v in rates_hs.items()}
     accepted, suspect = screen_rates(measured_mhs, last_measured)
     md5_acc = {l: v for l, v in accepted.items() if l in MD5_LABELS}
+    if not md5_acc:
+        line = {
+            "metric": "MH/s/chip md5 pow search (device hung mid-bench)",
+            "value": 0.0,
+            "unit": "MH/s",
+            "vs_baseline": 0.0,
+        }
+        if measured_mhs:
+            line["rates_mhs"] = {l: round(v, 2)
+                                 for l, v in measured_mhs.items()}
+        if note:
+            line["note"] = note
+        return line, None
     # headline selection: prefer md5 paths that measured CLEAN this run
     # — an inflated suspect reading must not steal the selection, and a
     # deflated one must not win it either (its screened value is the
@@ -838,10 +858,12 @@ def main() -> None:
     line, prov = finalize_record(rates, last_measured, baseline)
     # the measured roofline rides in provenance: the generated
     # registry-standing table (scripts/gen_registry_table.py) derives
-    # utilization percentages from it
-    if roofline:
+    # utilization percentages from it.  prov is None when no md5 stage
+    # measured (finalize_record's hung-device guard): emit the line but
+    # leave last_measured.json untouched.
+    if prov is not None and roofline:
         prov["roofline_tops"] = round(roofline / 1e12, 3)
-    elif last_measured and last_measured.get("roofline_tops"):
+    elif prov is not None and last_measured and last_measured.get("roofline_tops"):
         prov["roofline_tops"] = last_measured["roofline_tops"]
     for lbl, info in line.get("suspect_readings", {}).items():
         print(f"[bench] SUSPECT reading for {lbl}: "
@@ -857,12 +879,17 @@ def main() -> None:
               "for non-md5 models this run — non-md5 provenance is "
               "entirely carried forward", file=sys.stderr)
         line["production_gap"] = True
-        prov["production_gap"] = True
+        if prov is not None:
+            prov["production_gap"] = True
 
     # disarm BEFORE the real JSON line: the hang bailout must never
     # print a second line after a successful run
     WATCHDOG.stop()
-    _write_last_measured(prov)
+    if prov is not None:
+        _write_last_measured(prov)
+    else:
+        print("[bench] no md5 stage measured: provenance NOT refreshed",
+              file=sys.stderr)
     print(json.dumps(line))
 
 

@@ -93,10 +93,18 @@ class PallasBackend:
             if self.inner > 1 and (tile & (tile - 1)):
                 import math
 
-                k = 1 << (k.bit_length() - 1)
-                need = self.inner // math.gcd(k, self.inner)
+                # the pow2 rounding only commits together with a batch
+                # that makes inner effective; when the growth conditions
+                # fail, the ORIGINAL k is kept (shrink-inner behavior) —
+                # rounding unconditionally cost non-pow2 tiles with a
+                # non-pow2 multiplier up to ~2x launch amortization for
+                # nothing (advisor r5 low #1)
+                k2 = 1 << (k.bit_length() - 1)
+                need = self.inner // math.gcd(k2, self.inner)
                 n = batch // tile
-                if n % need:
+                if n % need == 0:
+                    k = k2
+                else:
                     cap = batch + max(tile, batch // 50)
                     grown = n + (need - n % need)
                     while grown * tile <= cap and (grown * tile) % tbc:
@@ -107,9 +115,10 @@ class PallasBackend:
                     reclamp = max(1, min(launch_steps,
                                          self.max_launch // gbatch))
                     if (gbatch <= cap and gbatch % tbc == 0
-                            and 1 << (reclamp.bit_length() - 1) >= k):
+                            and 1 << (reclamp.bit_length() - 1) >= k2):
                         batch = gbatch
                         chunks = max(1, batch // tbc)
+                        k = k2
             try:
                 # launch_steps just extends the kernel's sequential grid
                 # (ops/md5_pallas.py), so the kernel serves the big

@@ -25,6 +25,7 @@ import os
 import queue
 
 from ..nodes.client import Client
+from ..runtime import faults
 from ..runtime.config import ClientConfig, read_json_config
 
 
@@ -69,6 +70,9 @@ def main(argv=None) -> None:
         help="base difficulty in bits (must be a multiple of 4); "
         "translated to nibbles: --difficulty-bits 32 == --difficulty 8",
     )
+    ap.add_argument("--faults", default=None,
+                    help="fault-injection plan: JSON file path or inline "
+                         "JSON (chaos testing; docs/FAULTS.md)")
     args = ap.parse_args(argv)
 
     try:
@@ -79,6 +83,9 @@ def main(argv=None) -> None:
         ap.error(str(exc))
 
     cfg1 = read_json_config(args.config, ClientConfig)
+    plan_spec = args.faults or cfg1.FaultPlanFile
+    if plan_spec:
+        faults.install_from_spec(plan_spec)
     config2, reused_cfg1 = args.config2, False
     if config2 is None:
         sibling = os.path.join(

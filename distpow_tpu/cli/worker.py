@@ -25,6 +25,7 @@ import argparse
 import logging
 
 from ..nodes.worker import Worker, maybe_init_distributed
+from ..runtime import faults
 from ..runtime.config import WorkerConfig, read_json_config
 
 
@@ -35,6 +36,9 @@ def main(argv=None) -> None:
     ap.add_argument("--id", help="Worker ID, e.g. worker1")
     ap.add_argument("--listen", help="Listen address, e.g. 127.0.0.1:5000")
     ap.add_argument("--backend", help="Compute backend override")
+    ap.add_argument("--faults", default=None,
+                    help="fault-injection plan: JSON file path or inline "
+                         "JSON (chaos testing; docs/FAULTS.md)")
     ap.add_argument("--jax-coordinator", default=None,
                     help="jax.distributed coordinator HOST:PORT "
                          "(multi-host mesh)")
@@ -58,6 +62,9 @@ def main(argv=None) -> None:
         config.JaxNumProcesses = args.jax_num_processes
     if args.jax_process_id is not None:
         config.JaxProcessId = args.jax_process_id
+    plan_spec = args.faults or config.FaultPlanFile
+    if plan_spec:
+        faults.install_from_spec(plan_spec)
     logging.info("worker config: %s", config)
     Worker(config).run_forever()  # Worker() runs the multi-host bootstrap
 

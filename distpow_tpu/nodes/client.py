@@ -29,8 +29,14 @@ class Client:
     def initialize(self) -> "queue.Queue[MineResult]":
         if self._initialized:
             raise RuntimeError("client has been initialized before")
+        # coordinator-outage resilience knobs ride the config
+        # (nodes/powlib.py module docstring; defaults in ClientConfig)
         self.notify_queue = self.pow.initialize(
-            self.config.CoordAddr, self.config.ChCapacity
+            self.config.CoordAddr, self.config.ChCapacity,
+            retries=getattr(self.config, "MineRetries", None),
+            backoff_s=getattr(self.config, "MineBackoffS", None),
+            backoff_max_s=getattr(self.config, "MineBackoffMaxS", None),
+            attempt_timeout_s=getattr(self.config, "MineAttemptTimeoutS", None),
         )
         self.tracer = make_tracer(
             self.config.ClientID,

@@ -19,12 +19,32 @@ API parity:
 
 Documented divergences from the reference:
 
-* **RPC failure surfaces as an error result.**  The reference
-  ``log.Fatal``s the whole client process on a mine-RPC error
-  (powlib.go:161-162).  Here the notify queue delivers a ``MineResult``
-  with ``secret=None`` and ``error`` set, so a caller blocked on
-  ``get()`` observes the failure (a coordinator outage) and can retry —
-  it neither crashes nor hangs forever (VERDICT r1 weak #6).
+* **Coordinator outages are retried, then surfaced — never fatal.**
+  The reference ``log.Fatal``s the whole client process on a mine-RPC
+  error (powlib.go:161-162).  Here a *transport* failure (connection
+  reset/refused, truncated frame, attempt timeout —
+  ``rpc.RPCTransportError``) triggers automatic recovery: exponential
+  backoff with jitter (``backoff_delay``), a shared re-dial of the
+  coordinator connection, and a re-issue of the Mine call — safe
+  because Mine is idempotent (the coordinator's dominance cache and
+  per-key mutex absorb repeats).  A connection that is still healthy
+  (the failure was an attempt timeout or a silently dropped frame) is
+  kept and re-issued on; only a dead transport is re-dialed — one slow
+  mine hitting its attempt timeout never tears the shared connection
+  out from under sibling in-flight mines.  The retry budget is bounded
+  (``ClientConfig.MineRetries``); each failed attempt consumes one
+  unit, and a *successful* re-dial restores the full budget (an outage
+  is charged for its reconnect, not forever) — under an overall
+  attempts ceiling (10x the budget, min 8) so a flapping coordinator
+  still terminates.  Only when the budget or ceiling is
+  exhausted does the notify queue deliver a terminal ``MineResult``
+  with ``secret=None`` and ``error="degraded: ..."`` — a caller
+  blocked on ``get()`` observes the failure and can escalate; it
+  neither crashes nor hangs forever (VERDICT r1 weak #6).  An error
+  *returned by* the coordinator's handler (plain ``RPCError``) is not
+  retried — re-issuing would just re-earn it — and surfaces as an
+  error result immediately.  Counters: ``powlib.retries``,
+  ``powlib.reconnects``, ``powlib.degraded`` (runtime/metrics.py).
 * **Close handshake.**  The reference re-sends the close token so
   ``Close()`` rendezvouses with every in-flight goroutine
   (powlib.go:179-182) — a mechanism its tracing library needs to keep
@@ -39,17 +59,43 @@ from __future__ import annotations
 
 import logging
 import queue
+import random
 import threading
+import time
 from concurrent.futures import CancelledError
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass
 from typing import Optional
 
 from ..runtime import actions as act
-from ..runtime.rpc import RPCClient, RPCError
+from ..runtime.metrics import REGISTRY as metrics
+from ..runtime.rpc import RPCClient, RPCError, RPCTransportError
 from ..runtime.tracing import Tracer, decode_token, encode_token
 
 log = logging.getLogger("distpow.powlib")
+
+# Retry defaults (ClientConfig.Mine* fields override per client).
+DEFAULT_RETRIES = 4
+DEFAULT_BACKOFF_S = 0.2
+DEFAULT_BACKOFF_MAX_S = 2.0
+
+
+def backoff_delay(attempt: int, base: float, cap: float,
+                  rng: random.Random) -> float:
+    """Jittered exponential backoff: uniform in ``[u/2, u]`` where
+    ``u = min(cap, base * 2**attempt)`` — so every delay is positive,
+    never exceeds ``cap``, and the halved floor keeps reconnect storms
+    from synchronizing without ever collapsing the wait to zero."""
+    upper = min(cap, base * (2.0 ** attempt))
+    return upper * (0.5 + 0.5 * rng.random())
+
+
+class _Closed(Exception):
+    """Internal: close() was called while an attempt was in flight."""
+
+
+class _MineFailed(Exception):
+    """Internal: the attempt loop concluded with a client-visible error."""
 
 
 @dataclass
@@ -58,8 +104,9 @@ class MineResult:
     num_trailing_zeros: int
     secret: Optional[bytes]
     token: Optional[bytes] = None
-    # set (with secret=None) when the mine RPC failed — e.g. the
-    # coordinator went down mid-request; see module docstring
+    # set (with secret=None) when the mine RPC failed terminally — a
+    # coordinator handler error, or a coordinator outage that outlived
+    # the retry budget ("degraded: ..."); see module docstring
     error: Optional[str] = None
 
 
@@ -67,12 +114,40 @@ class POW:
     def __init__(self):
         self.coordinator: Optional[RPCClient] = None
         self.notify_queue: Optional["queue.Queue[MineResult]"] = None
+        self.coord_addr: Optional[str] = None
+        self.retries = DEFAULT_RETRIES
+        self.backoff_s = DEFAULT_BACKOFF_S
+        self.backoff_max_s = DEFAULT_BACKOFF_MAX_S
+        # per-attempt bound on waiting for the Mine response; None waits
+        # forever (a legitimate mine can run arbitrarily long, so only
+        # chaos/ops configs should set this)
+        self.attempt_timeout_s: Optional[float] = None
         self._close_ev = threading.Event()
         self._inflight: set = set()
         self._inflight_lock = threading.Lock()
+        # connection generation: in-flight threads that all hit the same
+        # outage coordinate through this so exactly one re-dials and the
+        # rest reuse the fresh connection
+        self._conn_lock = threading.Lock()
+        self._conn_gen = 0
+        self._rng = random.Random()  # jitter only — never correctness
 
-    def initialize(self, coord_addr: str, ch_capacity: int) -> "queue.Queue[MineResult]":
+    def initialize(self, coord_addr: str, ch_capacity: int, *,
+                   retries: Optional[int] = None,
+                   backoff_s: Optional[float] = None,
+                   backoff_max_s: Optional[float] = None,
+                   attempt_timeout_s: Optional[float] = None,
+                   ) -> "queue.Queue[MineResult]":
         log.info("dialing coordinator at %s", coord_addr)
+        self.coord_addr = coord_addr
+        if retries is not None:
+            self.retries = int(retries)
+        if backoff_s is not None:
+            self.backoff_s = float(backoff_s)
+        if backoff_max_s is not None:
+            self.backoff_max_s = float(backoff_max_s)
+        if attempt_timeout_s:  # 0/None both mean "wait forever"
+            self.attempt_timeout_s = float(attempt_timeout_s)
         self.coordinator = RPCClient(coord_addr)
         self.notify_queue = queue.Queue(maxsize=ch_capacity)
         self._close_ev.clear()
@@ -95,44 +170,153 @@ class POW:
             self._inflight.add(t)
         t.start()
 
+    # -- the retry machinery ------------------------------------------------
+    def _conn(self):
+        with self._conn_lock:
+            return self.coordinator, self._conn_gen
+
+    def _await_attempt(self, fut):
+        """Poll the future, honoring close() and the per-attempt bound."""
+        deadline = (
+            time.monotonic() + self.attempt_timeout_s
+            if self.attempt_timeout_s else None
+        )
+        while True:
+            if self._close_ev.is_set():
+                raise _Closed
+            try:
+                return fut.result(timeout=0.05)
+            except (TimeoutError, FutureTimeoutError):
+                # both spellings: concurrent.futures.TimeoutError is
+                # only an alias of the builtin since Python 3.11
+                if deadline is not None and time.monotonic() > deadline:
+                    # the frame (or its response) vanished on a healthy
+                    # connection — retryable like any transport fault;
+                    # the abandoned future is simply never read again
+                    raise RPCTransportError(
+                        f"mine attempt timed out after "
+                        f"{self.attempt_timeout_s:.1f}s"
+                    )
+                continue
+            except CancelledError:
+                raise _Closed
+
+    def _issue_attempt(self, client, trace, nonce: bytes, ntz: int) -> dict:
+        """One Mine RPC attempt on ``client`` (fresh token per attempt)."""
+        fut = client.go(
+            "CoordRPCHandler.Mine",
+            {
+                "nonce": list(nonce),
+                "num_trailing_zeros": ntz,
+                "token": encode_token(trace.generate_token()),
+            },
+        )
+        return self._await_attempt(fut)
+
+    def _reconnect(self, stale_gen: int, attempt: int) -> bool:
+        """Replace the shared coordinator connection after a transport
+        failure observed on generation ``stale_gen``.  Returns True when
+        the connection is fresh (this thread re-dialed successfully, or
+        a sibling already had) — the caller's cue to restore its retry
+        budget.  A connection that is still HEALTHY (``RPCClient.dead``
+        false — the failure was an attempt timeout or a dropped frame,
+        not a dead transport) is kept: tearing it down would fail every
+        sibling mine's pending future mid-flight; the caller simply
+        re-issues on it after the backoff.  Holding the lock across the
+        backoff sleep is deliberate: concurrent failed attempts queue up
+        behind the one re-dialer instead of hammering the coordinator
+        with parallel dials."""
+        with self._conn_lock:
+            if self.coordinator is None:
+                return False  # closing
+            if self._conn_gen != stale_gen:
+                return True  # a sibling attempt already replaced it
+            delay = backoff_delay(
+                attempt, self.backoff_s, self.backoff_max_s, self._rng
+            )
+            if self._close_ev.wait(delay):
+                return False
+            if not getattr(self.coordinator, "dead", True):
+                return False  # healthy transport: re-issue on it
+            try:
+                fresh = RPCClient(self.coord_addr)
+            except OSError as exc:
+                log.warning("coordinator re-dial failed: %s", exc)
+                return False
+            old, self.coordinator = self.coordinator, fresh
+            self._conn_gen += 1
+            metrics.inc("powlib.reconnects")
+            log.info("reconnected to coordinator at %s (gen %d)",
+                     self.coord_addr, self._conn_gen)
+        try:
+            old.close()
+        except OSError:
+            pass
+        return True
+
+    def _mine_with_retry(self, trace, nonce: bytes, ntz: int) -> Optional[dict]:
+        """Issue Mine until success, terminal failure (_MineFailed), or
+        close (returns None).  See the module docstring for semantics.
+
+        Liveness bound: budget resets on a successful re-dial mean a
+        FLAPPING coordinator (dial accepts, call dies, repeat) would
+        otherwise loop forever — the overall attempts ceiling keeps the
+        "terminal error, never a hang" contract true regardless of how
+        the outage flaps."""
+        budget = self.retries
+        attempt = 0
+        attempts_cap = max(8, self.retries * 10)
+        while True:
+            client, gen = self._conn()
+            if client is None:
+                return None
+            try:
+                return self._issue_attempt(client, trace, nonce, ntz)
+            except _Closed:
+                log.info("mine call abandoned on close")
+                return None
+            except RPCTransportError as exc:
+                attempt += 1
+                if budget <= 0 or attempt >= attempts_cap:
+                    metrics.inc("powlib.degraded")
+                    raise _MineFailed(
+                        f"degraded: mine RPC failed after {attempt} "
+                        f"attempt(s) ({self.retries}-retry budget): {exc}"
+                    )
+                budget -= 1
+                metrics.inc("powlib.retries")
+                log.warning(
+                    "mine RPC transport failure (%s); %d/%d retries left",
+                    exc, budget, self.retries,
+                )
+                if self._reconnect(gen, attempt - 1):
+                    budget = self.retries
+            except RPCError as exc:
+                # the coordinator's handler returned an error: re-issuing
+                # would re-earn it — surface immediately (module docstring)
+                raise _MineFailed(str(exc))
+
     def _call_mine(self, tracer, nonce, num_trailing_zeros, trace) -> None:
         try:
             trace.record_action(
                 act.PowlibMine(nonce=nonce, num_trailing_zeros=num_trailing_zeros)
             )
-            fut = self.coordinator.go(
-                "CoordRPCHandler.Mine",
-                {
-                    "nonce": list(nonce),
-                    "num_trailing_zeros": num_trailing_zeros,
-                    "token": encode_token(trace.generate_token()),
-                },
-            )
-            while True:
-                if self._close_ev.is_set():
-                    log.info("mine call abandoned on close")
-                    return
-                try:
-                    result = fut.result(timeout=0.05)
-                    break
-                except (TimeoutError, FutureTimeoutError):
-                    # both spellings: concurrent.futures.TimeoutError is
-                    # only an alias of the builtin since Python 3.11
-                    continue
-                except CancelledError:
-                    return
-                except RPCError as exc:
-                    log.error("mine RPC failed: %s", exc)
-                    if not self._close_ev.is_set():
-                        # deliver the failure: a silent drop would leave
-                        # the client blocked on the notify queue forever
-                        self.notify_queue.put(MineResult(
-                            nonce=nonce,
-                            num_trailing_zeros=num_trailing_zeros,
-                            secret=None,
-                            error=str(exc),
-                        ))
-                    return
+            try:
+                result = self._mine_with_retry(trace, nonce, num_trailing_zeros)
+            except _MineFailed as exc:
+                log.error("mine RPC failed: %s", exc)
+                if not self._close_ev.is_set():
+                    # deliver the failure: a silent drop would leave
+                    # the client blocked on the notify queue forever
+                    self.notify_queue.put(MineResult(
+                        nonce=nonce,
+                        num_trailing_zeros=num_trailing_zeros,
+                        secret=None,
+                        error=str(exc),
+                    ))
+                return
+            if result is None:  # closed mid-call
+                return
             token = decode_token(result["token"])
             result_trace = tracer.receive_token(token)
             mr = MineResult(
@@ -167,7 +351,8 @@ class POW:
             threads = list(self._inflight)
         for t in threads:
             t.join(timeout=5)
-        if self.coordinator is not None:
-            self.coordinator.close()
-            self.coordinator = None
+        with self._conn_lock:
+            client, self.coordinator = self.coordinator, None
+        if client is not None:
+            client.close()
         log.info("powlib closed")

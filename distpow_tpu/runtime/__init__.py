@@ -8,7 +8,8 @@ from .config import (
     read_json_config,
     write_json_config,
 )
-from .rpc import RPCClient, RPCError, RPCServer
+from . import faults
+from .rpc import RPCClient, RPCError, RPCServer, RPCTransportError
 from .trace_server import TracingServer
 from .tracing import (
     FileSink,
@@ -22,10 +23,10 @@ from .tracing import (
 )
 
 __all__ = [
-    "actions", "CacheEntry", "ResultCache",
+    "actions", "faults", "CacheEntry", "ResultCache",
     "ClientConfig", "CoordinatorConfig", "TracingServerConfig", "WorkerConfig",
     "read_json_config", "write_json_config",
-    "RPCClient", "RPCError", "RPCServer", "TracingServer",
+    "RPCClient", "RPCError", "RPCServer", "RPCTransportError", "TracingServer",
     "FileSink", "MemorySink", "TCPSink", "Trace", "Tracer",
     "decode_token", "encode_token", "make_tracer",
 ]

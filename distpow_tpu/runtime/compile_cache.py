@@ -47,16 +47,18 @@ def _classify(message: str) -> str | None:
     entry for ...")``; cache-key failures are ``logger.error(
     "compile_or_get_cached: unable to generate cache key, ...")``; the
     lru_cache eviction layer warns with its own messages mentioning the
-    compilation cache.  Matching is deliberately loose on everything
-    but the read/write verbs so a minor upstream rewording degrades to
-    the total counter, not to silence.
+    compilation cache.  The read/write breakdown anchors on jax's
+    LITERAL "error reading"/"error writing" phrasings — a looser word
+    search substring-matched "read" inside e.g. "thread" and could
+    misattribute unrelated cache warnings (advisor r5 low #2); anything
+    else cache-related degrades to the total counter, not to silence.
     """
     m = message.lower()
     if "compilation cache" not in m and "cache key" not in m:
         return None
-    if "error reading" in m or "read" in m.split("cache")[0]:
+    if "error reading" in m:
         return ERRORS_READ
-    if "error writing" in m or "writ" in m.split("cache")[0]:
+    if "error writing" in m:
         return ERRORS_WRITE
     if "cache key" in m:
         return ERRORS_KEYGEN

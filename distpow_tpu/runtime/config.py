@@ -55,6 +55,23 @@ class ClientConfig:
     TracerServerAddr: str = ""
     TracerSecret: bytes = b""
     ChCapacity: int = 10  # client.go:9
+    # --- TPU-native extensions -------------------------------------------
+    # Coordinator-outage resilience (nodes/powlib.py): a transport-level
+    # Mine failure is retried with jittered exponential backoff and a
+    # shared coordinator re-dial.  Each failed attempt consumes one unit
+    # of MineRetries; a successful re-dial restores the full budget; an
+    # exhausted budget delivers a terminal "degraded: ..." error result.
+    MineRetries: int = 4
+    MineBackoffS: float = 0.2
+    MineBackoffMaxS: float = 2.0
+    # Per-attempt bound on waiting for the Mine response.  0 = wait
+    # forever (the default — a legitimate mine can run arbitrarily long,
+    # so only chaos/ops configs that must detect silently-dropped frames
+    # should set this).
+    MineAttemptTimeoutS: float = 0.0
+    # Deterministic fault-injection plan (runtime/faults.py); empty = no
+    # injection.  Also reachable via $DISTPOW_FAULTS and --faults.
+    FaultPlanFile: str = ""
 
 
 @dataclass
@@ -80,6 +97,9 @@ class CoordinatorConfig:
     # Probe cadence (seconds) while blocked on worker results in
     # "reassign" mode.
     FailureProbeSecs: float = 1.0
+    # Deterministic fault-injection plan (runtime/faults.py); empty = no
+    # injection.  Also reachable via $DISTPOW_FAULTS and --faults.
+    FaultPlanFile: str = ""
 
 
 @dataclass
@@ -140,6 +160,9 @@ class WorkerConfig:
     # Orders of magnitude slower than the XLA step on CPU — never set in
     # production.
     PallasInterpret: bool = False
+    # Deterministic fault-injection plan (runtime/faults.py); empty = no
+    # injection.  Also reachable via $DISTPOW_FAULTS and --faults.
+    FaultPlanFile: str = ""
 
 
 @dataclass

@@ -18,6 +18,10 @@ Counter names in use:
 * ``coord.mine_rpcs`` / ``coord.fanouts`` / ``coord.late_results``
 * ``coord.worker_failures`` / ``coord.reassigned_shards``
 * ``cache.hit`` / ``cache.miss`` / ``cache.add`` / ``cache.evict``
+* ``powlib.retries`` / ``powlib.reconnects`` / ``powlib.degraded``
+  — client-side coordinator-outage recovery (nodes/powlib.py)
+* ``faults.injected.<kind>`` — fault-injection plane activity
+  (runtime/faults.py; kind in refuse/delay/truncate/duplicate/drop)
 """
 
 from __future__ import annotations

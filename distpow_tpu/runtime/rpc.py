@@ -26,12 +26,25 @@ import json
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, Optional, Tuple
+
+from . import faults
 
 
 class RPCError(Exception):
     pass
+
+
+class RPCTransportError(RPCError):
+    """A connection-level failure — refused dial, failed/partial send,
+    reader death — as opposed to an error *returned by* the remote
+    handler (which stays a plain :class:`RPCError`).  The distinction is
+    what makes client-side retry safe: a transport failure means the
+    peer may never have seen (or finished) the call, so re-issuing an
+    idempotent RPC (``Mine`` — the dominance cache absorbs repeats) is
+    correct, while a handler error would just be re-earned."""
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -55,6 +68,21 @@ def _write_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
     payload = json.dumps(obj).encode()
     with lock:
         sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _write_truncated(sock: socket.socket, obj: dict,
+                     lock: threading.Lock) -> None:
+    """Fault-plane helper (faults.py kind="truncate"): write a partial
+    frame — length prefix plus roughly half the payload — so the peer's
+    ``_read_exact`` sees a mid-frame connection reset when the caller
+    tears the socket down right after."""
+    payload = json.dumps(obj).encode()
+    frame = struct.pack(">I", len(payload)) + payload
+    try:
+        with lock:
+            sock.sendall(frame[: max(5, len(frame) // 2)])
+    except OSError:
+        pass
 
 
 def split_addr(addr: str) -> Tuple[str, int]:
@@ -128,6 +156,10 @@ class RPCServer:
     def _conn_loop(self, conn: socket.socket) -> None:
         wlock = threading.Lock()
         try:
+            peer = "%s:%s" % conn.getpeername()[:2]
+        except OSError:
+            peer = ""
+        try:
             while True:
                 req = _read_frame(conn)
                 if not isinstance(req, dict):
@@ -138,7 +170,7 @@ class RPCServer:
                     raise RPCError(f"non-object frame: {type(req).__name__}")
                 threading.Thread(
                     target=self._dispatch,
-                    args=(conn, wlock, req),
+                    args=(conn, wlock, req, peer),
                     daemon=True,
                 ).start()
         except (ConnectionError, OSError, ValueError, RPCError):
@@ -156,7 +188,7 @@ class RPCServer:
             except OSError:
                 pass
 
-    def _dispatch(self, conn, wlock, req: dict) -> None:
+    def _dispatch(self, conn, wlock, req: dict, peer: str = "") -> None:
         rid = req.get("id")
         try:
             service_name, _, method_name = req["method"].partition(".")
@@ -172,6 +204,34 @@ class RPCServer:
             resp = {"id": rid, "result": result, "error": None}
         except Exception as exc:  # handler errors travel to the caller
             resp = {"id": rid, "result": None, "error": f"{type(exc).__name__}: {exc}"}
+        if faults.PLAN is not None:
+            hit = faults.PLAN.on_frame(
+                "server", str(req.get("method") or ""), peer
+            )
+            if hit is not None:
+                kind, delay = hit
+                if kind == "delay":
+                    time.sleep(delay)
+                elif kind == "drop":
+                    return  # response silently never sent
+                elif kind == "duplicate":
+                    try:
+                        _write_frame(conn, resp, wlock)
+                        _write_frame(conn, resp, wlock)
+                    except OSError:
+                        pass
+                    return
+                elif kind == "truncate":
+                    # partial response, then reset: the peer's pending
+                    # calls on this connection all fail fast
+                    _write_truncated(conn, resp, wlock)
+                    for op in (lambda: conn.shutdown(socket.SHUT_RDWR),
+                               conn.close):
+                        try:
+                            op()
+                        except OSError:
+                            pass
+                    return
         try:
             _write_frame(conn, resp, wlock)
         except OSError:
@@ -240,6 +300,9 @@ class RPCClient:
 
     def __init__(self, addr: str, timeout: Optional[float] = 10.0,
                  send_timeout: float = 20.0):
+        self._addr = addr
+        if faults.PLAN is not None:
+            faults.PLAN.on_connect(addr)  # may delay or refuse the dial
         self._sock = socket.create_connection(split_addr(addr), timeout=timeout)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -286,10 +349,10 @@ class RPCClient:
                 # registers after (it sees _dead and fails fast) — no
                 # window where a future lands in the fresh dict with no
                 # reader to resolve it (review r4)
-                self._dead = RPCError(str(err))
+                self._dead = RPCTransportError(str(err))
             for fut in pending.values():
                 if not fut.done():
-                    fut.set_exception(RPCError(str(err)))
+                    fut.set_exception(RPCTransportError(str(err)))
             # and tear the CONNECTION down: on a protocol violation the
             # socket is still healthy, so without this a later go()/
             # call() would send fine and then wait forever on a reader
@@ -308,27 +371,56 @@ class RPCClient:
                 # a FRESH instance per future: raising a shared
                 # exception object from concurrent .result() callers
                 # would interleave their __traceback__s (review r4)
-                fut.set_exception(RPCError(str(self._dead)))
+                fut.set_exception(RPCTransportError(str(self._dead)))
                 return fut
             self._next_id += 1
             rid = self._next_id
             self._pending[rid] = fut
+        req = {"id": rid, "method": method, "params": params or {}}
+        duplicate = False
+        if faults.PLAN is not None:
+            hit = faults.PLAN.on_frame("client", method, self._addr)
+            if hit is not None:
+                kind, delay = hit
+                if kind == "delay":
+                    time.sleep(delay)
+                elif kind == "drop":
+                    # silently never sent; the connection stays healthy,
+                    # so only the caller's own timeout observes this
+                    return fut
+                elif kind == "duplicate":
+                    duplicate = True
+                elif kind == "truncate":
+                    # partial frame + teardown: the reader fails every
+                    # pending future (this one included) with a
+                    # transport error, like a real mid-frame reset
+                    _write_truncated(self._sock, req, self._wlock)
+                    self.close()
+                    return fut
         try:
-            _write_frame(
-                self._sock,
-                {"id": rid, "method": method, "params": params or {}},
-                self._wlock,
-            )
+            _write_frame(self._sock, req, self._wlock)
+            if duplicate:
+                _write_frame(self._sock, req, self._wlock)
         except OSError as exc:
             with self._plock:
                 self._pending.pop(rid, None)
-            fut.set_exception(RPCError(str(exc)))
+            fut.set_exception(RPCTransportError(str(exc)))
             # a failed sendall may have written a PARTIAL frame (SNDTIMEO
             # surfaces as BlockingIOError mid-write); the stream is
             # unusable — tear it down so the reader fails every pending
             # future and callers re-dial
             self.close()
         return fut
+
+    @property
+    def dead(self) -> bool:
+        """True once the transport is unusable (reader died or close()
+        was called).  False means the connection is healthy as far as
+        anyone can tell — a frame lost to a drop fault or an unanswered
+        call does NOT flip this; callers deciding whether to re-dial vs
+        re-issue on the same connection use exactly that distinction
+        (nodes/powlib.py _reconnect)."""
+        return self._dead is not None or self._closed
 
     def call(
         self, method: str, params: Optional[dict] = None, timeout: Optional[float] = None

@@ -4,7 +4,10 @@
 # multi-process tests, excl. the nightly veryslow tier — README Tests)
 # is `--full` (~10 min of pytest); this fast lane is what a pre-commit
 # check should run (~4 min).  `--nightly` adds the veryslow tier.
-# Usage: scripts/ci.sh [--full|--nightly]
+# `--chaos` runs only the deterministic fault-injection matrix plus the
+# canned chaos smoke replay (docs/FAULTS.md) — the fast/full lanes
+# already include the matrix via the un-slow `faults` marker.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -15,9 +18,14 @@ echo "=== test suite ==="
 case "${1:-}" in
   --nightly) python -m pytest tests/ -q ;;
   --full) python -m pytest tests/ -q -m "not veryslow" ;;
+  --chaos) python -m pytest tests/ -q -m faults
+           echo "=== chaos smoke replay ==="
+           python scripts/chaos_smoke.py
+           echo "=== chaos OK ==="
+           exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos]" >&2
           exit 2 ;;
 esac
 

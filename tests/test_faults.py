@@ -1,0 +1,470 @@
+"""Deterministic fault-injection chaos matrix (runtime/faults.py).
+
+Three claims are pinned here:
+
+1. **Determinism** — a fault plan is a seeded pure function of the call
+   sequence: identical seeds reproduce identical injected-fault
+   sequences, both at the plan level and through a real sequential
+   protocol run.
+2. **Survival** — every fault kind (refuse / delay / truncate /
+   duplicate / drop) is ridden out on BOTH control-plane links: the
+   client↔coordinator link via powlib's retry/backoff/reconnect
+   machinery, and the coordinator↔worker link via
+   ``FailurePolicy="reassign"``'s failure detection + shard
+   reassignment.  Every chaos run must still produce a valid secret.
+3. **Outage recovery** — a coordinator restart mid-mine completes the
+   mine through powlib's automatic reconnect with no client-visible
+   error, and the retry budget's edge cases (exhaustion => terminal
+   "degraded" error, not a hang; jittered backoff within bounds;
+   successful reconnect restores the budget) hold.
+"""
+
+import contextlib
+import queue
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from test_nodes import Stack, mine_and_wait  # noqa: E402
+
+from distpow_tpu.models import puzzle  # noqa: E402
+from distpow_tpu.nodes.powlib import (  # noqa: E402
+    POW,
+    backoff_delay,
+)
+from distpow_tpu.runtime import faults  # noqa: E402
+from distpow_tpu.runtime.faults import FaultPlan  # noqa: E402
+from distpow_tpu.runtime.metrics import REGISTRY as metrics  # noqa: E402
+from distpow_tpu.runtime.rpc import RPCTransportError  # noqa: E402
+from distpow_tpu.runtime.tracing import MemorySink, Tracer, encode_token  # noqa: E402
+
+pytestmark = pytest.mark.faults
+
+FAULT_KINDS = ("refuse", "delay", "truncate", "duplicate", "drop")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """A fault plan is process-global state: never leak one across
+    tests (or into the rest of the suite)."""
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# 1. determinism
+# ---------------------------------------------------------------------------
+
+MIXED_SPEC = {
+    "seed": 1234,
+    "rules": [
+        {"kind": "delay", "method": "CoordRPCHandler.Mine", "side": "client",
+         "prob": 0.5, "delay_s": 0.0},
+        {"kind": "drop", "method": "WorkerRPCHandler.*", "side": "client",
+         "prob": 0.3},
+        {"kind": "truncate", "method": "*.Result", "calls": "2:5",
+         "prob": 0.8},
+    ],
+}
+
+SYNTHETIC_CALLS = [
+    ("client", "CoordRPCHandler.Mine", "127.0.0.1:1"),
+    ("client", "WorkerRPCHandler.Mine", "127.0.0.1:2"),
+    ("server", "CoordRPCHandler.Result", "127.0.0.1:3"),
+    ("client", "WorkerRPCHandler.Found", "127.0.0.1:2"),
+] * 25
+
+
+def _drive(plan):
+    for side, method, peer in SYNTHETIC_CALLS:
+        plan.on_frame(side, method, peer)
+    return plan.injected
+
+
+def test_same_seed_same_injected_sequence():
+    a = _drive(FaultPlan.from_spec(MIXED_SPEC))
+    b = _drive(FaultPlan.from_spec(MIXED_SPEC))
+    assert a, "plan never fired — the matrix is vacuous"
+    assert a == b
+    # and the probabilistic rules actually declined sometimes (a plan
+    # that fires on every call proves nothing about seeded decisions)
+    assert len(a) < len(SYNTHETIC_CALLS)
+
+
+def test_different_seed_different_sequence():
+    other = dict(MIXED_SPEC, seed=999)
+    a = _drive(FaultPlan.from_spec(MIXED_SPEC))
+    b = _drive(FaultPlan.from_spec(other))
+    assert a != b
+
+
+def test_call_window_and_max_cap():
+    plan = FaultPlan(seed=7, rules=[
+        {"kind": "delay", "method": "M.x", "calls": "2:4", "delay_s": 0.0},
+        {"kind": "drop", "method": "M.y", "max": 1},
+    ])
+    hits = []
+    for i in range(6):
+        hits.append(plan.on_frame("client", "M.x", ""))
+    # fires exactly on matching-call indexes 2 and 3
+    assert [h is not None for h in hits] == [
+        False, False, True, True, False, False]
+    assert plan.on_frame("client", "M.y", "") is not None
+    assert plan.on_frame("client", "M.y", "") is None  # max=1 spent
+
+
+def test_env_and_file_install(tmp_path, monkeypatch):
+    spec = '{"seed": 5, "rules": [{"kind": "drop", "method": "A.b"}]}'
+    # inline JSON via the environment
+    monkeypatch.setenv("DISTPOW_FAULTS", spec)
+    faults._env_install()
+    assert faults.PLAN is not None and faults.PLAN.seed == 5
+    faults.uninstall()
+    # file path via install_from_spec (the --faults / FaultPlanFile route)
+    p = tmp_path / "plan.json"
+    p.write_text(spec)
+    plan = faults.install_from_spec(str(p))
+    assert faults.PLAN is plan and plan.rules[0].kind == "drop"
+
+
+def test_real_stack_sequential_run_is_deterministic():
+    """Six sequential mines through the full RPC stack: the injected
+    sequence (delay-only, so control flow never forks) replays exactly
+    under the same seed."""
+    spec = {
+        "seed": 42,
+        "rules": [
+            {"kind": "delay", "method": "CoordRPCHandler.Mine",
+             "side": "client", "prob": 0.5, "delay_s": 0.01},
+            {"kind": "delay", "method": "WorkerRPCHandler.Mine",
+             "side": "client", "prob": 0.5, "delay_s": 0.01},
+        ],
+    }
+
+    def run():
+        plan = faults.install_from_spec(spec)
+        s = Stack(1)
+        try:
+            client = s.new_client("client1")
+            for i in range(6):
+                res = mine_and_wait(client, bytes([0x70, i]), 2)
+                assert res.error is None
+                assert puzzle.check_secret(res.nonce, res.secret, 2)
+        finally:
+            s.close()
+            faults.uninstall()
+        return list(plan.injected)
+
+    first, second = run(), run()
+    assert first, "no faults injected — determinism claim is vacuous"
+    assert first == second
+
+
+# ---------------------------------------------------------------------------
+# 2. survival matrix: client <-> coordinator link
+# ---------------------------------------------------------------------------
+
+# client-side plans targeting the Mine RPC; installed AFTER the client
+# dialed, so the initial connect is clean and recovery is what's tested.
+CLIENT_LINK_PLANS = {
+    # truncate forces a re-dial; the refuse rule then rejects the next
+    # two reconnect dials before letting one through
+    "refuse": {"seed": 11, "rules": [
+        {"kind": "truncate", "method": "CoordRPCHandler.Mine",
+         "side": "client", "max": 1},
+        {"kind": "refuse", "max": 2},
+    ]},
+    "delay": {"seed": 12, "rules": [
+        {"kind": "delay", "method": "CoordRPCHandler.Mine",
+         "side": "client", "delay_s": 0.2, "max": 3},
+    ]},
+    "truncate": {"seed": 13, "rules": [
+        {"kind": "truncate", "method": "CoordRPCHandler.Mine",
+         "side": "client", "max": 1},
+    ]},
+    "duplicate": {"seed": 14, "rules": [
+        {"kind": "duplicate", "method": "CoordRPCHandler.Mine",
+         "side": "client", "max": 2},
+    ]},
+    # a dropped Mine frame is invisible on a healthy connection: only
+    # the per-attempt timeout can observe it (and then re-issue)
+    "drop": {"seed": 15, "rules": [
+        {"kind": "drop", "method": "CoordRPCHandler.Mine",
+         "side": "client", "max": 1},
+    ]},
+}
+
+
+@pytest.mark.parametrize("kind", FAULT_KINDS)
+def test_client_coordinator_link_survives(kind):
+    s = Stack(1)
+    try:
+        client = s.new_client(
+            "client1",
+            MineRetries=6, MineBackoffS=0.05, MineBackoffMaxS=0.3,
+            MineAttemptTimeoutS=2.0,
+        )
+        plan = faults.install_from_spec(CLIENT_LINK_PLANS[kind])
+        res = mine_and_wait(client, bytes([0x80, ord(kind[0])]), 2,
+                            timeout=60)
+        assert res.error is None, res.error
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        assert any(inj[1] == kind for inj in plan.injected), \
+            f"{kind} fault never injected — survival claim is vacuous"
+    finally:
+        s.close()
+
+
+def test_duplicate_mine_delivers_exactly_one_result():
+    """A duplicated Mine request is dispatched twice by the coordinator;
+    the client must still see exactly one result per mine() call."""
+    s = Stack(1)
+    try:
+        client = s.new_client("client1")
+        faults.install_from_spec({"seed": 3, "rules": [
+            {"kind": "duplicate", "method": "CoordRPCHandler.Mine",
+             "side": "client"},
+        ]})
+        res = mine_and_wait(client, b"\x81\x01", 2)
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        time.sleep(0.5)
+        assert client.notify_queue.empty(), \
+            "duplicated request leaked a second result"
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# 2b. survival matrix: coordinator <-> worker link
+# ---------------------------------------------------------------------------
+
+WORKER_LINK_PLANS = {
+    # the coordinator's first dial of a worker is refused once; reassign
+    # proceeds with the live subset and re-issues the orphaned shard
+    "refuse": {"seed": 21, "rules": [
+        {"kind": "refuse", "max": 1},
+    ]},
+    "delay": {"seed": 22, "rules": [
+        {"kind": "delay", "method": "WorkerRPCHandler.*", "side": "client",
+         "delay_s": 0.2, "max": 4},
+    ]},
+    # the worker's Mine RESPONSE is truncated: the coordinator sees a
+    # mid-frame reset, marks the worker dead, reassigns its shard
+    "truncate": {"seed": 23, "rules": [
+        {"kind": "truncate", "method": "WorkerRPCHandler.Mine",
+         "side": "server", "max": 1},
+    ]},
+    # the coordinator's Mine call to a worker is written twice: the
+    # worker's round supersede logic must absorb the repeat silently
+    "duplicate": {"seed": 24, "rules": [
+        {"kind": "duplicate", "method": "WorkerRPCHandler.Mine",
+         "side": "client", "max": 1},
+    ]},
+    # a dropped Mine call blocks until the bounded reassign-mode call
+    # timeout declares the worker dead and reassigns
+    "drop": {"seed": 25, "rules": [
+        {"kind": "drop", "method": "WorkerRPCHandler.Mine",
+         "side": "client", "max": 1},
+    ]},
+}
+
+
+@pytest.mark.parametrize("kind", FAULT_KINDS)
+def test_coordinator_worker_link_survives(kind):
+    s = Stack(2, failure_policy="reassign", failure_probe_secs=0.2)
+    s.coordinator.handler._call_timeout = 1.5
+    try:
+        client = s.new_client("client1")
+        plan = faults.install_from_spec(WORKER_LINK_PLANS[kind])
+        res = mine_and_wait(client, bytes([0x90, ord(kind[0])]), 2,
+                            timeout=60)
+        assert res.error is None, res.error
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        assert any(inj[1] == kind for inj in plan.injected), \
+            f"{kind} fault never injected — survival claim is vacuous"
+        # a second, fault-free request proves the stack healed
+        faults.uninstall()
+        res2 = mine_and_wait(client, bytes([0x91, ord(kind[0])]), 2,
+                             timeout=60)
+        assert puzzle.check_secret(res2.nonce, res2.secret, 2)
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. coordinator outage recovery + retry edge cases
+# ---------------------------------------------------------------------------
+
+def test_powlib_rides_out_coordinator_restart(tmp_path):
+    """The acceptance scenario: the coordinator dies mid-mine and comes
+    back on the same ports; powlib's automatic reconnect re-issues the
+    (idempotent) Mine and the client sees a normal result — NO
+    client-visible error (contrast tests/test_nodes.py
+    test_coordinator_restart_mid_mine, which pins the pre-retry
+    surface-the-error behavior once the budget is exhausted)."""
+    from distpow_tpu.nodes import Coordinator
+    from distpow_tpu.runtime.config import CoordinatorConfig
+
+    cache_file = str(tmp_path / "coord_cache.jsonl")
+    s = Stack(1, coord_cache_file=cache_file)
+    try:
+        client = s.new_client(
+            "client1",
+            MineRetries=10, MineBackoffS=0.1, MineBackoffMaxS=0.5,
+        )
+        nonce = b"\x79\x7a"
+        # difficulty 5 ~= 1M python-backend candidates: seconds of
+        # mining, plenty of window to restart the coordinator mid-search
+        client.mine(nonce, 5)
+        time.sleep(0.6)  # fan-out done, worker mining
+
+        old_client_addr = s.coordinator.client_addr
+        old_worker_addr = s.coordinator.worker_addr
+        worker_addrs = [w.bound_addr for w in s.workers]
+        s.coordinator.shutdown()
+
+        # restart on the same ports (create_server sets SO_REUSEADDR);
+        # retry briefly — re-dial loops targeting this very port can
+        # transiently occupy it via a Linux self-connect
+        for attempt in range(40):
+            try:
+                s.coordinator = Coordinator(
+                    CoordinatorConfig(
+                        ClientAPIListenAddr=old_client_addr,
+                        WorkerAPIListenAddr=old_worker_addr,
+                        Workers=worker_addrs,
+                        CacheFile=cache_file,
+                    ),
+                    sink=s.sinks["coordinator"],
+                )
+                s.coordinator.initialize_rpcs()
+                break
+            except OSError:
+                with contextlib.suppress(Exception):
+                    s.coordinator.shutdown()
+                if attempt == 39:
+                    raise
+                time.sleep(0.25)
+
+        # the ORIGINAL mine() call must complete: powlib reconnects and
+        # re-issues; the restarted coordinator serves it (from the
+        # journal-backed cache once the worker's forwarder re-delivers,
+        # or by re-fanning out)
+        res = client.notify_queue.get(timeout=120)
+        assert res.error is None, f"client saw the outage: {res.error}"
+        assert puzzle.check_secret(nonce, res.secret, 5)
+        assert metrics.get("powlib.reconnects") >= 1
+        assert metrics.get("powlib.retries") >= 1
+    finally:
+        s.close()
+
+
+def _wired_pow(retries: int) -> "POW":
+    """A POW with the retry loop wired but no real coordinator."""
+    pow_ = POW()
+    pow_.notify_queue = queue.Queue()
+    pow_.coordinator = object()  # non-None sentinel; attempts are stubbed
+    pow_.retries = retries
+    pow_.backoff_s = 0.01
+    pow_.backoff_max_s = 0.02
+    return pow_
+
+
+def test_retry_budget_exhaustion_is_terminal_error_not_hang():
+    pow_ = _wired_pow(retries=2)
+    pow_._issue_attempt = lambda client, trace, nonce, ntz: (
+        (_ for _ in ()).throw(RPCTransportError("boom")))
+    pow_._reconnect = lambda gen, attempt: False  # outage never heals
+    tracer = Tracer("clientX", MemorySink())
+    pow_.mine(tracer, b"\x01", 2)
+    res = pow_.notify_queue.get(timeout=10)  # a hang fails here
+    assert res.secret is None
+    assert res.error is not None and res.error.startswith("degraded:")
+    assert "2-retry budget" in res.error
+
+
+def test_flapping_coordinator_terminates_at_attempts_ceiling():
+    """Budget resets on every successful re-dial, so a coordinator that
+    accepts dials but kills every call could loop forever — the overall
+    attempts ceiling must convert that into a terminal degraded error."""
+    pow_ = _wired_pow(retries=2)
+    calls = {"n": 0}
+
+    def always_fails(client, trace, nonce, ntz):
+        calls["n"] += 1
+        raise RPCTransportError("flap")
+
+    pow_._issue_attempt = always_fails
+    pow_._reconnect = lambda gen, attempt: True  # every re-dial "succeeds"
+    tracer = Tracer("clientZ", MemorySink())
+    pow_.mine(tracer, b"\x03", 2)
+    res = pow_.notify_queue.get(timeout=20)
+    assert res.error is not None and res.error.startswith("degraded:")
+    assert calls["n"] == max(8, pow_.retries * 10)
+
+
+def test_successful_reconnect_resets_budget():
+    """Two separate one-failure outages must both be survivable on a
+    budget of 1: each failed attempt consumes the budget, each
+    successful reconnect restores it."""
+    pow_ = _wired_pow(retries=1)
+    tracer = Tracer("clientY", MemorySink())
+    calls = {"n": 0}
+
+    def scripted_attempt(client, trace, nonce, ntz):
+        calls["n"] += 1
+        if calls["n"] <= 2:  # outage 1 and outage 2
+            raise RPCTransportError(f"outage {calls['n']}")
+        return {
+            "nonce": list(nonce),
+            "num_trailing_zeros": ntz,
+            "secret": [0x42],
+            "token": encode_token(tracer.create_trace().generate_token()),
+        }
+
+    pow_._issue_attempt = scripted_attempt
+    pow_._reconnect = lambda gen, attempt: True  # re-dial always succeeds
+    pow_.mine(tracer, b"\x02", 2)
+    res = pow_.notify_queue.get(timeout=10)
+    assert res.error is None, res.error
+    assert res.secret == b"\x42"
+    assert calls["n"] == 3
+
+
+def test_backoff_stays_within_configured_bounds():
+    import random
+
+    rng = random.Random(123)
+    base, cap = 0.1, 1.5
+    for attempt in range(10):
+        upper = min(cap, base * 2 ** attempt)
+        for _ in range(200):
+            d = backoff_delay(attempt, base, cap, rng)
+            assert 0 < d <= cap
+            assert upper / 2 <= d <= upper
+
+
+def test_app_level_error_is_not_retried():
+    """An error RESPONSE from the coordinator (handler raised — e.g.
+    'no live workers') must surface immediately, not burn the retry
+    budget re-earning it."""
+    s = Stack(1, failure_policy="reassign", failure_probe_secs=0.1)
+    try:
+        s.workers[0].shutdown()
+        client = s.new_client("client1", MineRetries=50,
+                              MineBackoffS=0.5, MineBackoffMaxS=5.0)
+        t0 = time.time()
+        client.mine(b"\x6b\x6c", 2)
+        r = client.notify_queue.get(timeout=10.0)
+        # retrying 50x at 0.5s+ backoff would blow the 10s window; an
+        # immediate surface proves the app-error path skipped the budget
+        assert r.secret is None and r.error is not None
+        assert not r.error.startswith("degraded:")
+        assert time.time() - t0 < 8.0
+    finally:
+        s.close()

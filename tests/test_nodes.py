@@ -71,10 +71,13 @@ class Stack:
         self.coord_client_addr = client_addr
         self.clients = []
 
-    def new_client(self, cid: str) -> Client:
+    def new_client(self, cid: str, **cfg_extra) -> Client:
+        """``cfg_extra``: extra ClientConfig fields (e.g. the powlib
+        retry knobs the fault-injection tests tune)."""
         self.sinks[cid] = self._sink_factory(cid)
         c = Client(
-            ClientConfig(ClientID=cid, CoordAddr=self.coord_client_addr),
+            ClientConfig(ClientID=cid, CoordAddr=self.coord_client_addr,
+                         **cfg_extra),
             sink=self.sinks[cid],
         )
         c.initialize()
