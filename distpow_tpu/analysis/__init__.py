@@ -1,0 +1,23 @@
+"""distpow-lint — project-native static analysis (docs/LINT.md).
+
+The repo's correctness rests on invariants that live in comments and
+reviewer memory: lock discipline around device dispatch and RPC, the
+16-action trace vocabulary that reference parity depends on, the
+metrics-counter registry, config-key agreement between readers and the
+``runtime/config.py`` dataclasses, host-sync discipline on the hot
+path, and never-silent exception handling in the protocol planes.  The
+reference repo leaned on Go's race detector and ``go vet``; this
+package is the TPU-native analogue — a self-contained AST rule engine
+(stdlib only, no jax import) with one module per rule, line-level
+suppression via ``# distpow: ok <rule-id> -- <justification>``, JSON
+and human output, and an exit-code contract CI can gate on
+(``scripts/ci.sh --lint``; the ``lint``-marked tier-1 test enforces a
+clean tree on every fast suite run).
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    ProjectContext,
+    build_context,
+    run_analysis,
+)

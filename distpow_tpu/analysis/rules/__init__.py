@@ -1,0 +1,24 @@
+"""Rule registry — one module per rule, each exposing ``RULE_ID``,
+``DESCRIPTION`` and ``check(module, context)`` (and optionally
+``scan_tree(root, rel_to, context)`` for directory-level rules).
+Catalog with rationale and examples: docs/LINT.md."""
+
+from . import (
+    blocking_under_lock,
+    config_key_sync,
+    dead_package,
+    hot_path_host_sync,
+    metrics_registry,
+    silent_except,
+    trace_vocabulary,
+)
+
+ALL_RULES = (
+    blocking_under_lock,
+    trace_vocabulary,
+    metrics_registry,
+    config_key_sync,
+    hot_path_host_sync,
+    silent_except,
+    dead_package,
+)

@@ -1,0 +1,72 @@
+"""hot-path-host-sync — no host synchronization inside the device path.
+
+The pipelined driver (``parallel/search.py``) exists to keep the
+device busy: the host prepares launch N+1 while the device crunches
+launch N, and the ONLY sanctioned sync point is the FIFO drain
+(``int(res)`` in ``drain_one``).  A stray ``.item()``,
+``np.asarray``/``np.array`` on a device value, ``jax.device_get`` or
+``.block_until_ready()`` inside ``ops/`` or the driver serializes the
+pipeline — one launch in flight instead of ``pipeline_depth`` — which
+is invisible to every correctness test and only shows up as a silent
+2x serving-rate regression on hardware.  Deliberate sync points (a
+warmup that *wants* to block) are suppressed with the justification
+inline.
+
+Scope: ``distpow_tpu/ops/`` and ``distpow_tpu/parallel/search.py``.
+``jnp.asarray`` is device-side and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ._util import dotted_name, in_dirs, is_module, receiver_name
+
+RULE_ID = "hot-path-host-sync"
+DESCRIPTION = (
+    "no .item()/np.asarray/jax.device_get/block_until_ready inside "
+    "ops/ or the pipelined driver"
+)
+
+SYNC_ATTRS = frozenset({"item", "block_until_ready"})
+NUMPY_RECEIVERS = frozenset({"np", "numpy"})
+NUMPY_SYNC_FNS = frozenset({"asarray", "array"})
+
+
+def _in_scope(path: str) -> bool:
+    return in_dirs(path, "ops") or is_module(path, "parallel/search.py")
+
+
+def check(module, context) -> Iterator:
+    if not _in_scope(module.path):
+        return
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        recv = receiver_name(func)
+        full = dotted_name(func)
+        if func.attr in SYNC_ATTRS:
+            yield module.finding(
+                RULE_ID, node,
+                f".{func.attr}() forces a host sync inside the device "
+                f"hot path — it serializes the launch pipeline; drain "
+                f"through the driver's FIFO instead, or suppress with "
+                f"why this sync is intended",
+            )
+        elif recv in NUMPY_RECEIVERS and func.attr in NUMPY_SYNC_FNS:
+            yield module.finding(
+                RULE_ID, node,
+                f"{full}(...) copies device values to host inside the "
+                f"hot path — use jnp (device-side) or move the copy "
+                f"out of the dispatch loop",
+            )
+        elif full == "jax.device_get":
+            yield module.finding(
+                RULE_ID, node,
+                "jax.device_get(...) blocks on device results inside "
+                "the hot path — drain through the driver's FIFO",
+            )

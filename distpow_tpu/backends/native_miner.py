@@ -41,6 +41,10 @@ def load_library(build: bool = True) -> ctypes.CDLL:
             return _lib
         if not os.path.exists(_LIB_PATH) and build:
             try:
+                # distpow: ok no-blocking-under-lock -- one-shot lazy
+                # build under the load lock is the point: concurrent
+                # first-callers must block until the single make finishes
+                # rather than race parallel builds of the same .so
                 subprocess.run(
                     ["make", "-C", _NATIVE_DIR],
                     check=True, capture_output=True, text=True,

@@ -234,11 +234,18 @@ class POW:
             delay = backoff_delay(
                 attempt, self.backoff_s, self.backoff_max_s, self._rng
             )
+            # distpow: ok no-blocking-under-lock -- holding _conn_lock
+            # across the backoff is the design (docstring above): failed
+            # attempts queue behind the one re-dialer instead of dial-
+            # storming the coordinator; the wait is close()-interruptible
             if self._close_ev.wait(delay):
                 return False
             if not getattr(self.coordinator, "dead", True):
                 return False  # healthy transport: re-issue on it
             try:
+                # distpow: ok no-blocking-under-lock -- exactly-one-dialer:
+                # the lock exists to make this dial exclusive (see above);
+                # the connect has the RPCClient default dial timeout
                 fresh = RPCClient(self.coord_addr)
             except OSError as exc:
                 log.warning("coordinator re-dial failed: %s", exc)

@@ -138,6 +138,11 @@ class ResultCache:
             if entry is not None and entry.num_trailing_zeros >= num_trailing_zeros:
                 metrics.inc("cache.hit")
                 if trace:
+                    # distpow: ok no-blocking-under-lock -- trace emission
+                    # order must match cache state order (the reference
+                    # records from inside its cache mutex,
+                    # coordinator.go:403); emitting after release lets a
+                    # concurrent add interleave a contradictory event
                     trace.record_action(
                         CacheHit(
                             nonce=nonce,
@@ -148,6 +153,8 @@ class ResultCache:
                 return entry.secret
             metrics.inc("cache.miss")
             if trace:
+                # distpow: ok no-blocking-under-lock -- same mutex-order
+                # invariant as the hit path above
                 trace.record_action(
                     CacheMiss(nonce=nonce, num_trailing_zeros=num_trailing_zeros)
                 )
@@ -170,6 +177,9 @@ class ResultCache:
                 self._entries[nonce] = CacheEntry(num_trailing_zeros, secret)
                 self._append(nonce, num_trailing_zeros, secret)
                 if trace:
+                    # distpow: ok no-blocking-under-lock -- CacheAdd must
+                    # be emitted in cache-mutation order (reference emits
+                    # inside the cache mutex, coordinator.go:436)
                     trace.record_action(
                         CacheAdd(
                             nonce=nonce,
@@ -190,6 +200,8 @@ class ResultCache:
                 # cache mutex, coordinator.go:436-454) and trace_check.py
                 # asserts that adjacency — per-action locking would let a
                 # concurrent handler interleave an event between them
+                # distpow: ok no-blocking-under-lock -- the adjacency
+                # invariant above requires emitting under the cache mutex
                 trace.record_actions(
                     CacheRemove(
                         nonce=nonce,

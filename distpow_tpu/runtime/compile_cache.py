@@ -84,7 +84,11 @@ class _CacheErrorLogHandler(logging.Handler):
         if record.levelno >= logging.ERROR:
             try:
                 _count(record.getMessage(), "log")
-            except Exception:  # a metrics bug must never break logging
+            # distpow: ok silent-except -- this handler runs INSIDE the
+            # logging machinery it instruments: raising would recurse, and
+            # logging the failure from here would re-enter emit(); silence
+            # is the only safe behavior for a counter bug
+            except Exception:
                 pass
 
 
@@ -109,6 +113,10 @@ def _install_error_counters() -> None:
                         file=None, line=None):
             try:
                 _count(str(message), "warning")
+            # distpow: ok silent-except -- runs inside warnings.showwarning:
+            # a raise here would break EVERY warning in the process, and the
+            # chained prev() below must run regardless; a counter bug costs
+            # one count, never the warning itself
             except Exception:
                 pass
             prev(message, category, filename, lineno, file, line)

@@ -43,7 +43,7 @@ def _decode_secret(v) -> bytes:
     if isinstance(v, str):
         try:
             return base64.b64decode(v)
-        except Exception:
+        except ValueError:  # binascii.Error — not base64: raw-string secret
             return v.encode()
     return bytes(v)
 

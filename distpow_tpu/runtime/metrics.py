@@ -7,7 +7,9 @@ process has one ``REGISTRY``; hot paths increment named counters and the
 node's ``Stats`` RPC ships a snapshot (see nodes/coordinator.py and
 nodes/worker.py; ``python -m distpow_tpu.cli.stats`` prints it).
 
-Counter names in use:
+Counter names in use (machine-checked: ``KNOWN_COUNTERS`` below is the
+declaration distpow-lint's ``metrics-registry`` rule verifies every
+``metrics.inc("…")`` call site against — docs/LINT.md):
 
 * ``search.hashes``        — candidates evaluated (all backends)
 * ``search.launches``      — device dispatches
@@ -15,11 +17,20 @@ Counter names in use:
 * ``search.found``         — searches that returned a secret
 * ``worker.mine_rpcs`` / ``worker.found_rpcs`` / ``worker.cancel_rpcs``
 * ``worker.results_sent``  — messages queued to the forwarder
+* ``worker.forward_retries`` — result deliveries retried after a
+  coordinator outage (nodes/worker.py start_forwarder)
 * ``coord.mine_rpcs`` / ``coord.fanouts`` / ``coord.late_results``
 * ``coord.worker_failures`` / ``coord.reassigned_shards``
+* ``coord.stale_results_dropped`` — zombie-round results dropped by the
+  Result handler's round tag (nodes/coordinator.py module docstring)
 * ``cache.hit`` / ``cache.miss`` / ``cache.add`` / ``cache.evict``
 * ``powlib.retries`` / ``powlib.reconnects`` / ``powlib.degraded``
   — client-side coordinator-outage recovery (nodes/powlib.py)
+* ``rpc.handler_errors`` — handler exceptions returned to callers in
+  the response frame (runtime/rpc.py _dispatch)
+* ``compile_cache.errors`` (+ ``.read_errors`` / ``.write_errors`` /
+  ``.keygen_errors``) — persistent XLA cache failures
+  (runtime/compile_cache.py)
 * ``faults.injected.<kind>`` — fault-injection plane activity
   (runtime/faults.py; kind in refuse/delay/truncate/duplicate/drop)
 """
@@ -32,9 +43,36 @@ from typing import Dict, Union
 
 Number = Union[int, float]
 
+# The declared counter registry.  distpow-lint's ``metrics-registry``
+# rule parses these two literals (AST, no import) and flags any
+# ``metrics.inc``/``REGISTRY.inc`` call site whose literal name is not
+# declared here — a typo'd counter otherwise splits silently into a
+# real-but-frozen counter and a ghost twin nobody reads.  Keep the
+# docstring list above and this set in sync (test_lint.py asserts it).
+KNOWN_COUNTERS = frozenset({
+    "search.hashes", "search.launches", "search.cancelled", "search.found",
+    "worker.mine_rpcs", "worker.found_rpcs", "worker.cancel_rpcs",
+    "worker.results_sent", "worker.forward_retries",
+    "coord.mine_rpcs", "coord.fanouts", "coord.late_results",
+    "coord.worker_failures", "coord.reassigned_shards",
+    "coord.stale_results_dropped",
+    "cache.hit", "cache.miss", "cache.add", "cache.evict",
+    "powlib.retries", "powlib.reconnects", "powlib.degraded",
+    "rpc.handler_errors",
+    "compile_cache.errors", "compile_cache.read_errors",
+    "compile_cache.write_errors", "compile_cache.keygen_errors",
+})
+
+# Families minted from runtime values (f-string call sites): the
+# literal prefix must match one of these.
+KNOWN_COUNTER_PREFIXES = frozenset({
+    "faults.injected.",
+    "search.",  # backends/__init__.py count_exit: search.{cancelled,found}
+})
+
 
 class Metrics:
-    def __init__(self):
+    def __init__(self) -> None:
         self._counters: Dict[str, Number] = {}
         self._gauges: Dict[str, Number] = {}
         self._lock = threading.Lock()

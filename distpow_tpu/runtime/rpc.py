@@ -31,6 +31,7 @@ from concurrent.futures import Future
 from typing import Any, Dict, Optional, Tuple
 
 from . import faults
+from .metrics import REGISTRY as metrics
 
 
 class RPCError(Exception):
@@ -67,6 +68,9 @@ def _read_frame(sock: socket.socket) -> dict:
 def _write_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
     payload = json.dumps(obj).encode()
     with lock:
+        # distpow: ok no-blocking-under-lock -- this lock IS the frame
+        # serializer: interleaved sendall from two threads would corrupt
+        # the length-prefixed stream; the send is bounded by SO_SNDTIMEO
         sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
@@ -80,6 +84,9 @@ def _write_truncated(sock: socket.socket, obj: dict,
     frame = struct.pack(">I", len(payload)) + payload
     try:
         with lock:
+            # distpow: ok no-blocking-under-lock -- same frame-serializer
+            # lock as _write_frame; the deliberately-torn fault frame must
+            # not interleave with a concurrent healthy write either
             sock.sendall(frame[: max(5, len(frame) // 2)])
     except OSError:
         pass
@@ -203,6 +210,7 @@ class RPCServer:
             result = method(req.get("params") or {})
             resp = {"id": rid, "result": result, "error": None}
         except Exception as exc:  # handler errors travel to the caller
+            metrics.inc("rpc.handler_errors")
             resp = {"id": rid, "result": None, "error": f"{type(exc).__name__}: {exc}"}
         if faults.PLAN is not None:
             hit = faults.PLAN.on_frame(

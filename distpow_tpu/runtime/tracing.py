@@ -117,6 +117,11 @@ class TCPSink:
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
+                    # distpow: ok no-blocking-under-lock -- the sink lock
+                    # is the per-connection frame serializer (same
+                    # invariant as rpc._write_frame); only tracer threads
+                    # of this process contend here, and a wedged tracing
+                    # server costs trace records, never protocol progress
                     self._sock.sendall(
                         struct.pack(">I", len(payload)) + payload
                     )
@@ -213,6 +218,9 @@ class Tracer:
             # emit INSIDE the lock: clock tick and wire order must agree,
             # or concurrent threads ship events out of clock order and the
             # ShiViz happens-before stream is corrupt
+            # distpow: ok no-blocking-under-lock -- that ordering invariant
+            # REQUIRES the emit under the clock lock; the TCP sink degrades
+            # to dropping events rather than blocking indefinitely
             self._emit(
                 {
                     "type": "receive_token",
@@ -238,6 +246,10 @@ class Tracer:
             for action in actions:
                 self._tick_locked()
                 vc = dict(self._vc)
+                # distpow: ok no-blocking-under-lock -- clock tick and
+                # wire order must agree (see receive_token); emitting
+                # outside the lock lets concurrent recorders invert the
+                # happens-before stream
                 self._emit(
                     {
                         "type": "action",
@@ -253,6 +265,8 @@ class Tracer:
         with self._lock:
             self._tick_locked()
             vc = dict(self._vc)
+            # distpow: ok no-blocking-under-lock -- clock tick and wire
+            # order must agree (see receive_token)
             self._emit(
                 {
                     "type": "generate_token",

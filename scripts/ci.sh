@@ -7,9 +7,38 @@
 # `--chaos` runs only the deterministic fault-injection matrix plus the
 # canned chaos smoke replay (docs/FAULTS.md) — the fast/full lanes
 # already include the matrix via the un-slow `faults` marker.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos]
+# `--lint` runs the static gate alone: distpow-lint (docs/LINT.md)
+# against the committed empty baseline, then ruff and mypy when
+# installed (`pip install -e .[lint]`; skipped with a note otherwise —
+# the gate itself is stdlib-only).  The fast/full lanes already enforce
+# distpow-lint via the un-slow `lint` marker.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint]
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+run_lint() {
+  echo "=== distpow-lint (AST rule engine, docs/LINT.md) ==="
+  python scripts/lint.py distpow_tpu/ --baseline scripts/lint_baseline.json
+  echo "=== ruff ==="
+  if command -v ruff >/dev/null 2>&1; then
+    ruff check distpow_tpu/ scripts/ tests/
+  else
+    echo "ruff not installed; skipping (pip install -e .[lint])"
+  fi
+  echo "=== mypy (strict-leaning on runtime/ + nodes/) ==="
+  if command -v mypy >/dev/null 2>&1; then
+    mypy distpow_tpu/runtime distpow_tpu/nodes
+  else
+    echo "mypy not installed; skipping (pip install -e .[lint])"
+  fi
+  echo "=== lint OK ==="
+}
+
+# the static gate needs no native build — run and exit early
+if [ "${1:-}" = "--lint" ]; then
+  run_lint
+  exit 0
+fi
 
 echo "=== native miner build ==="
 make -C distpow_tpu/backends/native
@@ -25,7 +54,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint]" >&2
           exit 2 ;;
 esac
 

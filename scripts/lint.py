@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""distpow-lint CLI — run the project-native AST rule engine.
+
+Usage:
+    python scripts/lint.py [PATHS...] [--json] [--list-rules]
+                           [--rule ID [--rule ID ...]]
+                           [--baseline FILE]
+
+Defaults to scanning ``distpow_tpu/``.  Exit codes: 0 clean (suppressed
+findings allowed), 1 active findings, 2 usage/internal error.  The rule
+catalog with rationale, examples and the suppression policy lives in
+docs/LINT.md; ``scripts/ci.sh --lint`` runs this plus ruff and mypy
+(both skipped with a note when not installed — the container policy is
+stdlib-only for the gate itself).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distpow_tpu.analysis import build_context, run_analysis  # noqa: E402
+from distpow_tpu.analysis.engine import load_baseline  # noqa: E402
+from distpow_tpu.analysis.rules import ALL_RULES  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="distpow-lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to scan (default: distpow_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--rule", action="append", dest="rules", metavar="ID",
+                    help="run only the named rule (repeatable)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="JSON baseline of grandfathered findings "
+                         "(the committed one is empty and stays empty)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.RULE_ID:24s} {rule.DESCRIPTION}")
+        return 0
+
+    paths = args.paths or [os.path.join(REPO, "distpow_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"lint: no such path: {p}", file=sys.stderr)
+            return 2
+    known = {r.RULE_ID for r in ALL_RULES}
+    if args.rules and not set(args.rules) <= known:
+        print(f"lint: unknown rule(s): {sorted(set(args.rules) - known)}",
+              file=sys.stderr)
+        return 2
+
+    pkg_root = os.path.join(REPO, "distpow_tpu")
+    context = build_context(pkg_root) if os.path.isdir(pkg_root) else None
+    report = run_analysis(paths, context=context, rule_ids=args.rules,
+                          rel_to=os.getcwd())
+
+    findings = report.findings
+    if args.baseline:
+        try:
+            grandfathered = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            print(f"lint: unreadable baseline {args.baseline}: {exc}",
+                  file=sys.stderr)
+            return 2
+        findings = [f for f in findings
+                    if (f.rule, f.path, f.message) not in grandfathered]
+
+    if args.as_json:
+        payload = report.to_json()
+        payload["findings"] = [f.to_json() for f in findings]
+        payload["ok"] = not findings
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        print(
+            f"distpow-lint: {report.checked_files} file(s), "
+            f"{len(findings)} finding(s), "
+            f"{len(report.suppressed)} suppressed (all justified)",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,25 +29,42 @@ note() { echo "[$(date +%T)] $*" | tee -a "$LOG"; }
 
 wait_device() {
   # probe in a subprocess with a hard timeout (an in-process SIGALRM
-  # never fires inside a hung C call); crash != outage
-  local tries="${1:-400}"
-  for i in $(seq 1 "$tries"); do
+  # never fires inside a hung C call); crash != outage.
+  # VERDICT r5 items 1/7: every probe is journaled append-only
+  # (timestamp, rc, latency — probe.jsonl is the outage evidence the
+  # overwritten probe.err could never be), and there is NO give-up cap:
+  # the watcher re-arms indefinitely with exponential backoff (90 s ->
+  # 15 min between probes), so a long outage costs waiting, never the
+  # remaining queue.
+  local probe_n=0 backoff=90 backoff_max=900
+  while :; do
+    probe_n=$((probe_n + 1))
+    local t0 t1 rc
+    t0=$(date +%s)
     timeout 150 python -c \
       "import jax, jax.numpy as jnp; assert int(jnp.uint32(2)+jnp.uint32(3))==5" \
       2>"$OUT/probe.err"
-    local rc=$?
+    rc=$?
+    t1=$(date +%s)
+    printf '{"ts":"%s","probe":%d,"rc":%d,"latency_s":%d}\n' \
+      "$(date -u +%FT%TZ)" "$probe_n" "$rc" "$((t1 - t0))" \
+      >>"$OUT/probe.jsonl"
     if [ "$rc" -eq 0 ]; then
-      note "device up"
+      note "device up (probe $probe_n, $((t1 - t0))s)"
       return 0
     elif [ "$rc" -ne 124 ] && [ "$rc" -ne 143 ]; then
       note "probe CRASHED (rc=$rc) — broken environment, aborting:"
       tail -5 "$OUT/probe.err" | tee -a "$LOG"
+      # preserve the crash stderr with the journal (probe.err is
+      # per-attempt scratch, overwritten by the next probe)
+      cp "$OUT/probe.err" "$OUT/probe_crash_${probe_n}.err" 2>/dev/null
       exit 1
     fi
-    sleep 90
+    note "device still down (probe $probe_n, rc=$rc); next probe in ${backoff}s"
+    sleep "$backoff"
+    backoff=$((backoff * 2))
+    [ "$backoff" -gt "$backoff_max" ] && backoff=$backoff_max
   done
-  note "device never appeared; giving up"
-  return 1
 }
 
 stage() {
@@ -60,18 +77,18 @@ stage() {
   local rc=$?
   note "stage $name rc=$rc"
   tail -4 "$OUT/$name.log" | tee -a "$LOG"
-  wait_device 400 || exit 1
+  wait_device || exit 1
 }
 
 note "r5 session start"
-wait_device 400 || exit 1
+wait_device || exit 1
 
 # 1. the headline: one full registry pass on a healthy window
 note "=== stage bench1 ==="
 timeout 1500 python bench.py >"$OUT/bench1.json" 2>"$OUT/bench1.log"
 note "bench1 rc=$?"
 cat "$OUT/bench1.json" | tee -a "$LOG"
-wait_device 400 || exit 1
+wait_device || exit 1
 
 # 2. the blake2b e2e row (plus the whole registry's latency table)
 stage e2e_models 2400 python scripts/e2e_models.py 6 "$OUT/e2e_models.json"
@@ -81,7 +98,7 @@ note "=== stage bench2 ==="
 timeout 1200 python bench.py >"$OUT/bench2.json" 2>"$OUT/bench2.log"
 note "bench2 rc=$?"
 cat "$OUT/bench2.json" | tee -a "$LOG"
-wait_device 400 || exit 1
+wait_device || exit 1
 
 # 4. cold vs cache-hot worker boot (VERDICT r4 item 2)
 stage restart 3600 python scripts/compile_cache_restart.py \

@@ -1,0 +1,30 @@
+"""Fixture: no-blocking-under-lock must fire on every blocking kind."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+class Node:
+    def __init__(self, client, sock, backend, ev):
+        self._state_lock = threading.Lock()
+        self.client = client
+        self.sock = sock
+        self.backend = backend
+        self.ev = ev
+
+    def bad_rpc_under_lock(self):
+        with self._state_lock:
+            return self.client.call("Service.Method", {})  # line 19: call
+
+    def bad_send_and_sleep(self):
+        with _lock:
+            self.sock.sendall(b"frame")  # line 23: sendall
+            time.sleep(0.1)  # line 24: sleep
+
+    def bad_search_and_wait(self):
+        with self._state_lock:
+            secret = self.backend.search(b"n", 4, [0])  # line 28: search
+            self.ev.wait(1.0)  # line 29: wait
+            return secret

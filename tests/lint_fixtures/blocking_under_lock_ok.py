@@ -1,0 +1,36 @@
+"""Fixture: the clean shapes no-blocking-under-lock must NOT flag."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+class Node:
+    def __init__(self, client, sock, backend, ev):
+        self._state_lock = threading.Lock()
+        self.client = client
+        self.sock = sock
+        self.backend = backend
+        self.ev = ev
+        self.pending = []
+
+    def snapshot_then_send(self):
+        # blocking work AFTER the critical section is the sanctioned shape
+        with self._state_lock:
+            frame = bytes(self.pending.pop())
+        self.sock.sendall(frame)
+        time.sleep(0.1)
+        return self.client.call("Service.Method", {})
+
+    def callback_defined_under_lock(self):
+        # a nested def under the lock runs LATER, outside the hold
+        with self._state_lock:
+            def later():
+                return self.backend.search(b"n", 4, [0])
+        return later
+
+    def regex_is_not_io(self, pattern):
+        import re
+        with _lock:
+            return re.search(pattern, "haystack")

@@ -1,0 +1,12 @@
+"""Fixture: config-key-sync must flag undeclared config keys."""
+
+
+def boot(config):
+    backend = config.Backend  # declared: fine
+    batch = config.BatchSzie  # line 6: typo of BatchSize
+    cache = getattr(config, "CacheFiIe", "")  # line 7: typo of CacheFile
+    return backend, batch, cache
+
+
+def rebind(cfg):
+    cfg.ListenAddress = ":0"  # line 12: field is ListenAddr

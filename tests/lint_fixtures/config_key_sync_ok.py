@@ -1,0 +1,16 @@
+"""Fixture: declared config-key reads config-key-sync must accept."""
+
+
+class Worker:
+    def __init__(self, config):
+        self.config = config
+
+    def boot(self, args):
+        backend = self.config.Backend
+        hang = float(getattr(self.config, "DeviceHangTimeoutS", 0.0) or 0.0)
+        # lowercase attributes are methods/derived state, not JSON keys
+        as_dict = self.config.to_dict() if hasattr(self.config, "to_dict") \
+            else None
+        # non-config receivers are out of scope (argparse namespaces)
+        path = args.config
+        return backend, hang, as_dict, path

@@ -1,0 +1,3 @@
+"""Fixture: a package with a real module is not dead."""
+
+VALUE = 1

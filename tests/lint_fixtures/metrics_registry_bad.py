@@ -1,0 +1,12 @@
+"""Fixture: metrics-registry must flag undeclared counter names."""
+
+from distpow_tpu.runtime.metrics import REGISTRY as metrics
+from distpow_tpu.runtime.metrics import REGISTRY
+
+GHOST = "coord.phantom_counter"
+
+
+def hot_path(kind):
+    metrics.inc("coord.fanout")  # line 10: typo of coord.fanouts
+    REGISTRY.inc(GHOST)  # line 11: resolvable constant, undeclared
+    metrics.inc(f"mystery.{kind}")  # line 12: undeclared prefix

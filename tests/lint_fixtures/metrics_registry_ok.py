@@ -1,0 +1,14 @@
+"""Fixture: declared counters metrics-registry must accept."""
+
+from distpow_tpu.runtime.metrics import REGISTRY as metrics
+
+TOTAL = "compile_cache.errors"
+
+
+def hot_path(kind, dynamic_name):
+    metrics.inc("coord.fanouts")
+    metrics.inc("search.hashes", 1024)
+    metrics.inc(TOTAL)
+    metrics.inc(f"faults.injected.{kind}")
+    # fully dynamic names are a documented limitation, not a finding
+    metrics.inc(dynamic_name)

@@ -1,0 +1,14 @@
+"""Fixture (scope: ops/): hot-path-host-sync must flag host syncs."""
+
+import numpy as np
+
+import jax
+
+
+def drain(results, launch):
+    first = results[0].item()  # line 9: .item()
+    host = np.asarray(results[1])  # line 10: np.asarray
+    copied = np.array(results[2])  # line 11: np.array
+    fetched = jax.device_get(results[3])  # line 12: device_get
+    launch.block_until_ready()  # line 13: block_until_ready
+    return first, host, copied, fetched

@@ -1,0 +1,12 @@
+"""Fixture (scope: ops/): device-side shapes hot-path-host-sync accepts."""
+
+import jax.numpy as jnp
+
+
+def step(state, masks):
+    # jnp.asarray is device-side — exempt
+    init = jnp.asarray(state, jnp.uint32)
+    mask = jnp.asarray(masks, jnp.uint32)
+    # int() on a drained FIFO result is the sanctioned sync point and
+    # deliberately not in the flagged set (the driver owns it)
+    return init & mask

@@ -1,0 +1,24 @@
+"""Fixture (scope: runtime/): silent-except must flag silent handlers."""
+
+
+def swallow_everything(op):
+    try:
+        return op()
+    except Exception:  # line 7: silent broad catch
+        return None
+
+
+def swallow_bare(op):
+    try:
+        return op()
+    except:  # noqa: E722  # line 13: bare except
+        pass
+
+
+def logs_in_callback_only(op, log):
+    try:
+        return op()
+    except Exception:  # line 19: the nested def runs later, if ever
+        def report():
+            log.warning("failed")
+        return report

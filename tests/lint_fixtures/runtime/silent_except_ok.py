@@ -1,0 +1,37 @@
+"""Fixture (scope: runtime/): accounted handlers silent-except accepts."""
+
+import logging
+
+from distpow_tpu.runtime.metrics import REGISTRY as metrics
+
+log = logging.getLogger("fixture")
+
+
+def logged(op):
+    try:
+        return op()
+    except Exception as exc:
+        log.warning("operation failed: %s", exc)
+        return None
+
+
+def counted(op):
+    try:
+        return op()
+    except Exception:
+        metrics.inc("search.cancelled")
+        return None
+
+
+def reraised(op):
+    try:
+        return op()
+    except Exception:
+        raise RuntimeError("wrapped")
+
+
+def narrow_is_fine(path):
+    try:
+        return open(path).read()
+    except OSError:
+        return ""

@@ -1,0 +1,13 @@
+"""Fixture: a suppression WITHOUT a justification is itself a finding
+(bare-suppression) — the original finding stays silenced but the
+policy violation surfaces."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def unjustified_hold():
+    with _lock:
+        time.sleep(0.01)  # distpow: ok no-blocking-under-lock

@@ -1,0 +1,14 @@
+"""Fixture: a justified suppression silences the finding and is
+counted in the report's suppressed list."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def deliberate_hold():
+    with _lock:
+        # distpow: ok no-blocking-under-lock -- fixture: the hold is the
+        # documented design and this justification says why
+        time.sleep(0.01)

@@ -1,0 +1,7 @@
+"""Fixture: a suppression matching no finding is reported as
+unused-suppression — stale suppressions must not rot in the tree."""
+
+
+def nothing_wrong_here():
+    # distpow: ok no-blocking-under-lock -- stale: the lock is long gone
+    return 42

@@ -1,0 +1,21 @@
+"""Fixture: trace-vocabulary must flag undeclared actions and
+out-of-band Action subclasses."""
+
+from dataclasses import dataclass
+
+from distpow_tpu.runtime import actions as act
+from distpow_tpu.runtime.actions import Action
+
+
+@dataclass(frozen=True)
+class WorkerSideChannel(Action):  # line 11: subclass outside actions.py
+    nonce: bytes
+
+
+def record(trace, nonce):
+    trace.record_action(
+        act.WorkerFrobnicate(nonce=nonce)  # line 17: undeclared action
+    )
+    trace.record_action(
+        act.CoordinatorMinee(nonce=nonce, num_trailing_zeros=4)  # typo'd
+    )

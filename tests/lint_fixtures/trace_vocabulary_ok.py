@@ -1,0 +1,15 @@
+"""Fixture: declared-vocabulary usage trace-vocabulary must accept."""
+
+from distpow_tpu.runtime import actions as act
+from distpow_tpu.runtime.actions import CacheAdd
+
+
+def record(trace, nonce, secret):
+    trace.record_action(
+        act.WorkerMine(nonce=nonce, num_trailing_zeros=4, worker_byte=0)
+    )
+    trace.record_action(
+        CacheAdd(nonce=nonce, num_trailing_zeros=4, secret=secret)
+    )
+    # lowercase attributes on the alias are not action constructions
+    return act.Action
