@@ -106,6 +106,8 @@ class ProjectContext:
     action_names: Set[str] = field(default_factory=set)
     counters: Set[str] = field(default_factory=set)
     counter_prefixes: Tuple[str, ...] = ()
+    histograms: Set[str] = field(default_factory=set)
+    histogram_prefixes: Tuple[str, ...] = ()
     config_fields: Set[str] = field(default_factory=set)
 
 
@@ -259,6 +261,12 @@ def build_context(package_root: str) -> ProjectContext:
             ctx.counters = _string_set_from_assign(tree, "KNOWN_COUNTERS")
             ctx.counter_prefixes = tuple(sorted(
                 _string_set_from_assign(tree, "KNOWN_COUNTER_PREFIXES")
+            ))
+            ctx.histograms = _string_set_from_assign(
+                tree, "KNOWN_HISTOGRAMS"
+            )
+            ctx.histogram_prefixes = tuple(sorted(
+                _string_set_from_assign(tree, "KNOWN_HISTOGRAM_PREFIXES")
             ))
     if os.path.exists(config_py):
         tree = _parse_file(config_py)

@@ -1,16 +1,21 @@
-"""metrics-registry — every counter name must be declared.
+"""metrics-registry — every counter AND histogram name must be declared.
 
-``Metrics.inc`` creates counters on first touch, so a typo'd name
-(``coord.fanout`` for ``coord.fanouts``) silently splits a counter into
-two and every dashboard/asserting test reading the real name sees
-frozen zeros — exactly the hand-transcribed-counts drift class VERDICT
-r5 called out.  The registry is declared in ``runtime/metrics.py``
-(``KNOWN_COUNTERS`` exact names, ``KNOWN_COUNTER_PREFIXES`` for
-families minted from runtime values like ``faults.injected.<kind>``);
-this rule checks every ``metrics.inc(...)`` / ``REGISTRY.inc(...)``
-call site against it:
+``Metrics.inc``/``Metrics.observe`` create series on first touch, so a
+typo'd name (``coord.fanout`` for ``coord.fanouts``; ``worker.solve``
+for ``worker.solve_s``) silently splits a series into two and every
+dashboard/asserting test reading the real name sees frozen zeros —
+exactly the hand-transcribed-counts drift class VERDICT r5 called out.
+The registry is declared in ``runtime/metrics.py``:
 
-* a string literal must be in ``KNOWN_COUNTERS``;
+* ``KNOWN_COUNTERS`` / ``KNOWN_COUNTER_PREFIXES`` gate
+  ``metrics.inc(...)`` / ``REGISTRY.inc(...)`` call sites;
+* ``KNOWN_HISTOGRAMS`` / ``KNOWN_HISTOGRAM_PREFIXES`` gate
+  ``metrics.observe(...)`` and ``metrics.time(...)`` call sites (the
+  ISSUE-3 latency telemetry plane).
+
+Resolution, per call site:
+
+* a string literal must be in the exact-name set;
 * an f-string's leading literal text must match a declared prefix;
 * a bare name is resolved through same-module string constants
   (``REGISTRY.inc(ERRORS_TOTAL)``); anything still dynamic is skipped
@@ -21,37 +26,53 @@ call site against it:
 from __future__ import annotations
 
 import ast
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Tuple
 
 from ._util import is_module, receiver_name, resolve_str_constant
 
 RULE_ID = "metrics-registry"
 DESCRIPTION = (
-    "metrics.inc() counter names must be declared in "
-    "runtime/metrics.py KNOWN_COUNTERS / KNOWN_COUNTER_PREFIXES"
+    "metrics.inc()/observe()/time() series names must be declared in "
+    "runtime/metrics.py KNOWN_COUNTERS / KNOWN_HISTOGRAMS (+ prefixes)"
 )
 
 RECEIVERS = frozenset({"metrics", "REGISTRY"})
+COUNTER_METHODS = frozenset({"inc"})
+HISTOGRAM_METHODS = frozenset({"observe", "time"})
 
 
-def _counter_arg(call: ast.Call) -> Optional[ast.AST]:
-    if isinstance(call.func, ast.Attribute) and call.func.attr == "inc" \
-            and receiver_name(call.func) in RECEIVERS and call.args:
-        return call.args[0]
+def _series_arg(call: ast.Call) -> Optional[Tuple[str, ast.AST]]:
+    """(family, name-arg) for a registry call site, else None.
+    ``family`` is "counter" or "histogram"."""
+    if not (isinstance(call.func, ast.Attribute)
+            and receiver_name(call.func) in RECEIVERS and call.args):
+        return None
+    if call.func.attr in COUNTER_METHODS:
+        return "counter", call.args[0]
+    if call.func.attr in HISTOGRAM_METHODS:
+        return "histogram", call.args[0]
     return None
 
 
 def check(module, context) -> Iterator:
-    if not context.counters:
+    if not context.counters and not context.histograms:
         return  # registry not parsed (fixture tree without metrics.py)
     if is_module(module.path, "runtime/metrics.py"):
         return
+    declared = {
+        "counter": (context.counters, context.counter_prefixes,
+                    "KNOWN_COUNTERS", "KNOWN_COUNTER_PREFIXES"),
+        "histogram": (context.histograms, context.histogram_prefixes,
+                      "KNOWN_HISTOGRAMS", "KNOWN_HISTOGRAM_PREFIXES"),
+    }
     for node in ast.walk(module.tree):
         if not isinstance(node, ast.Call):
             continue
-        arg = _counter_arg(node)
-        if arg is None:
+        hit = _series_arg(node)
+        if hit is None:
             continue
+        family, arg = hit
+        names, prefixes, names_decl, prefixes_decl = declared[family]
         if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
             name: Optional[str] = arg.value
         elif isinstance(arg, ast.Name):
@@ -64,22 +85,20 @@ def check(module, context) -> Iterator:
                     isinstance(head.value, str)):
                 continue  # leading formatted value: fully dynamic, skip
             prefix = head.value
-            if not any(
-                    prefix.startswith(p)
-                    for p in context.counter_prefixes):
+            if not any(prefix.startswith(p) for p in prefixes):
                 yield module.finding(
                     RULE_ID, node,
-                    f"f-string counter prefix {prefix!r} matches no "
-                    f"declared prefix in KNOWN_COUNTER_PREFIXES "
-                    f"({', '.join(context.counter_prefixes) or 'none'})",
+                    f"f-string {family} prefix {prefix!r} matches no "
+                    f"declared prefix in {prefixes_decl} "
+                    f"({', '.join(prefixes) or 'none'})",
                 )
             continue
         else:
             continue
-        if name not in context.counters:
+        if name not in names:
             yield module.finding(
                 RULE_ID, node,
-                f"counter {name!r} is not declared in "
-                f"runtime/metrics.py KNOWN_COUNTERS — declare it (and "
+                f"{family} {name!r} is not declared in "
+                f"runtime/metrics.py {names_decl} — declare it (and "
                 f"its docstring entry) or fix the typo",
             )

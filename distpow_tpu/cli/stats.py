@@ -1,12 +1,23 @@
 """Node metrics inspector (no reference equivalent — SURVEY.md section 5
 lists metrics as absent in the reference).
 
-    python -m distpow_tpu.cli.stats --addr HOST:PORT [--role auto|coordinator|worker]
+    python -m distpow_tpu.cli.stats --addr HOST:PORT
+        [--role auto|coordinator|worker] [--prom] [--watch SECS [--count N]]
 
 Dials the node's RPC port, calls its ``Stats`` method, and prints the
 JSON snapshot.  ``--role auto`` (default) tries the coordinator service
 name first, then the worker's.  For a coordinator, use the CLIENT-facing
 listen address.
+
+``--prom`` renders the snapshot as Prometheus text exposition (version
+0.0.4): counters/gauges become ``distpow_<name>`` samples and every
+histogram becomes a full ``_bucket{le=...}/_sum/_count`` family built
+from the registry's log buckets — point any Prometheus scrape job at a
+thin exporter wrapping this, or eyeball percentile movement directly.
+``--watch SECS`` re-fetches every SECS seconds and prints counter
+deltas plus live histogram quantiles (``--count N`` bounds the
+refreshes; default unbounded, Ctrl-C exits).  docs/METRICS.md is the
+registry catalog.
 """
 
 from __future__ import annotations
@@ -14,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from concurrent.futures import TimeoutError as FutureTimeout
 
 from ..runtime.rpc import RPCClient, RPCError
@@ -39,23 +51,147 @@ def fetch_stats(addr: str, role: str = "auto", timeout: float = 5.0) -> dict:
         client.close()
 
 
+def _prom_name(name: str) -> str:
+    """Registry name -> Prometheus metric name (dots and any other
+    non-identifier characters become underscores)."""
+    safe = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    if safe and safe[0].isdigit():
+        safe = "_" + safe
+    return f"distpow_{safe}"
+
+
+def _prom_num(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(snap: dict) -> str:
+    """Snapshot -> Prometheus text exposition (0.0.4).
+
+    Histograms are re-emitted cumulatively from the snapshot's
+    non-cumulative log buckets (runtime/metrics.py Histogram.to_dict),
+    closed by the mandatory ``+Inf`` bucket equal to ``_count``.
+    """
+    out = []
+    role = snap.get("role", "unknown")
+    out.append("# HELP distpow_node_info node role marker (value is 1)")
+    out.append("# TYPE distpow_node_info gauge")
+    out.append(f'distpow_node_info{{role="{role}"}} 1')
+    if "uptime_secs" in snap:
+        out.append("# TYPE distpow_uptime_seconds gauge")
+        out.append(f"distpow_uptime_seconds {_prom_num(snap['uptime_secs'])}")
+    for name, v in sorted((snap.get("counters") or {}).items()):
+        pname = _prom_name(name) + "_total"
+        out.append(f"# TYPE {pname} counter")
+        out.append(f"{pname} {_prom_num(v)}")
+    for name, v in sorted((snap.get("gauges") or {}).items()):
+        pname = _prom_name(name)
+        out.append(f"# TYPE {pname} gauge")
+        out.append(f"{pname} {_prom_num(v)}")
+    for name, h in sorted((snap.get("histograms") or {}).items()):
+        pname = _prom_name(name)
+        out.append(f"# TYPE {pname} histogram")
+        cum = 0
+        for le, count in h.get("buckets", []):
+            cum += count
+            out.append(f'{pname}_bucket{{le="{_prom_num(le)}"}} {cum}')
+        out.append(f'{pname}_bucket{{le="+Inf"}} {h["count"]}')
+        out.append(f"{pname}_sum {_prom_num(h.get('sum', 0))}")
+        out.append(f"{pname}_count {h['count']}")
+    return "\n".join(out) + "\n"
+
+
+def _fmt_quantiles(h: dict) -> str:
+    def f(v):
+        return "-" if v is None else f"{v:.4g}"
+
+    return (f"n={h['count']} p50={f(h.get('p50'))} "
+            f"p95={f(h.get('p95'))} p99={f(h.get('p99'))} "
+            f"max={f(h.get('max'))}")
+
+
+def render_watch_delta(prev: dict, snap: dict) -> str:
+    """One --watch refresh frame: counter deltas since the previous
+    snapshot (only movers shown), current gauges, histogram quantiles."""
+    out = [f"--- {snap.get('role', '?')} @ {time.strftime('%H:%M:%S')} "
+           f"(uptime {snap.get('uptime_secs', 0):.0f}s)"]
+    pc = (prev.get("counters") or {}) if prev else {}
+    moved = False
+    for name, v in sorted((snap.get("counters") or {}).items()):
+        d = v - pc.get(name, 0)
+        if d:
+            out.append(f"  {name:34s} {v:>12} (+{d})")
+            moved = True
+    if not moved:
+        out.append("  (no counter movement)")
+    for name, v in sorted((snap.get("gauges") or {}).items()):
+        out.append(f"  {name:34s} {v:>12}")
+    for name, h in sorted((snap.get("histograms") or {}).items()):
+        out.append(f"  {name:34s} {_fmt_quantiles(h)}")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="print a distpow node's metrics")
     ap.add_argument("--addr", required=True, help="node RPC address host:port")
     ap.add_argument("--role", choices=["auto", "coordinator", "worker"],
                     default="auto")
     ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--prom", action="store_true",
+                    help="Prometheus text exposition instead of JSON")
+    ap.add_argument("--watch", type=float, metavar="SECS", default=None,
+                    help="refresh every SECS seconds, printing deltas")
+    ap.add_argument("--count", type=int, default=0,
+                    help="with --watch: stop after N refreshes (0 = forever)")
     args = ap.parse_args(argv)
+    if args.watch is not None and args.watch <= 0:
+        ap.error("--watch SECS must be positive")
+
     try:
-        snap = fetch_stats(args.addr, args.role, args.timeout)
+        prev: dict = {}
+        n = 0
+        while True:
+            try:
+                snap = fetch_stats(args.addr, args.role, args.timeout)
+            except (OSError, RPCError, FutureTimeout) as exc:
+                if args.watch is None:
+                    raise
+                # watch mode exists to observe nodes THROUGH outages: a
+                # refused dial during a restart must not end the session
+                # at exactly the moment the deltas matter.  A failed
+                # fetch still consumes one --count slot, so a bounded
+                # watch terminates even against a permanently dead node
+                print(f"[stats] fetch failed ({exc}); retrying in "
+                      f"{args.watch}s", file=sys.stderr)
+                n += 1
+                if args.count and n >= args.count:
+                    return 1
+                time.sleep(args.watch)
+                continue
+            if args.prom:
+                text = render_prometheus(snap)
+            elif args.watch is not None:
+                text = render_watch_delta(prev, snap)
+            else:
+                text = json.dumps(snap, indent=2, sort_keys=True)
+            try:
+                print(text, flush=True)
+            except BrokenPipeError:  # e.g. piped into `head`
+                return 0
+            if args.watch is None:
+                return 0
+            prev = snap
+            n += 1
+            if args.count and n >= args.count:
+                return 0
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
     except (OSError, RPCError, FutureTimeout) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
-    try:
-        print(json.dumps(snap, indent=2, sort_keys=True))
-    except BrokenPipeError:  # e.g. piped into `head`
-        pass
-    return 0
 
 
 if __name__ == "__main__":

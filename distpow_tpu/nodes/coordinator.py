@@ -66,6 +66,7 @@ from ..runtime.cache import ResultCache
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import CoordinatorConfig
 from ..runtime.rpc import RPCClient, RPCError, RPCServer
+from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
 
 log = logging.getLogger("distpow.coordinator")
@@ -359,6 +360,7 @@ class CoordRPCHandler:
 
     # -- RPCs ---------------------------------------------------------------
     def Mine(self, params) -> dict:
+        t0 = time.monotonic()
         metrics.inc("coord.mine_rpcs")
         nonce = bytes(params["nonce"])
         ntz = int(params["num_trailing_zeros"])
@@ -369,6 +371,7 @@ class CoordRPCHandler:
 
         cached = self.result_cache.get(nonce, ntz, trace)
         if cached is not None:
+            metrics.observe("coord.mine_s.hit", time.monotonic() - t0)
             return self._success_reply(trace, nonce, ntz, cached)
 
         # serialize concurrent identical requests (documented fix; the
@@ -376,8 +379,19 @@ class CoordRPCHandler:
         with self._key_lock((nonce, ntz)):
             cached = self.result_cache.get(nonce, ntz, trace)
             if cached is not None:
+                # a duplicate that waited out the first request's miss
+                # still counts as a hit: the split is by cache outcome,
+                # not by how long the key lock made it wait
+                metrics.observe("coord.mine_s.hit", time.monotonic() - t0)
                 return self._success_reply(trace, nonce, ntz, cached)
-            return self._mine_miss(trace, nonce, ntz)
+            try:
+                return self._mine_miss(trace, nonce, ntz)
+            finally:
+                # errors included (the rpc.py dispatch-timing
+                # discipline): an all-workers-died RuntimeError after
+                # minutes of reassign probing is exactly the outage
+                # latency this split exists to show
+                metrics.observe("coord.mine_s.miss", time.monotonic() - t0)
 
     def _send_mine(self, trace, nonce: bytes, ntz: int, w: WorkerRef,
                    worker_byte: int, rid: str) -> bool:
@@ -410,6 +424,9 @@ class CoordRPCHandler:
             log.warning("worker %d failed Mine for shard %d: %s",
                         w.worker_byte, worker_byte, exc)
             metrics.inc("coord.worker_failures")
+            RECORDER.record("coord.worker_failure",
+                            worker_byte=w.worker_byte, shard=worker_byte,
+                            round=rid, error=str(exc))
             self._mark_dead(w)
             return False
 
@@ -453,6 +470,12 @@ class CoordRPCHandler:
     def _mine_miss_locked(self, trace, nonce: bytes, ntz: int, results,
                           reassign: bool, probe_t, rid: str) -> dict:
         metrics.inc("coord.fanouts")
+        # the fan-out instant anchors this round's two latency
+        # distributions: fanout->first-result (the race the paper's
+        # contract is about) and fanout->last-ack (cancel propagation)
+        fanout_t0 = time.monotonic()
+        RECORDER.record("coord.fanout", round=rid, nonce=nonce.hex(),
+                        ntz=ntz)
         tasks, pending = self._assign_shards(trace, nonce, ntz, rid)
 
         # first-result-wins (coordinator.go:202-206); under "reassign",
@@ -470,6 +493,12 @@ class CoordRPCHandler:
                 tasks, pending = self._issue_shards(
                     trace, nonce, ntz, tasks, pending + orphans, rid
                 )
+        first_result_s = time.monotonic() - fanout_t0
+        metrics.observe("coord.first_result_s", first_result_s)
+        RECORDER.record("coord.first_result", round=rid,
+                        nonce=nonce.hex(), ntz=ntz,
+                        worker_byte=int(first["worker_byte"]),
+                        latency_s=round(first_result_s, 6))
         if first["secret"] is None:
             raise RuntimeError(
                 "protocol violation: first worker message was a cancellation "
@@ -501,6 +530,15 @@ class CoordRPCHandler:
             b = int(msg["worker_byte"])
             if b in remaining:
                 remaining[b] -= 1
+        # the 2N-ack ledger just drained: every surviving worker has
+        # acknowledged the cancellation — fanout->last-ack is the
+        # cancel-propagation latency the ISSUE-3 plane measures
+        cancel_s = time.monotonic() - fanout_t0
+        metrics.observe("coord.cancel_propagation_s", cancel_s)
+        RECORDER.record("coord.cancel_complete", round=rid,
+                        nonce=nonce.hex(), ntz=ntz,
+                        late_results=len(late),
+                        latency_s=round(cancel_s, 6))
 
         # late-result cache propagation (coordinator.go:250-280): each
         # rebroadcast is acked once per task (cache-update-only round)
@@ -669,6 +707,16 @@ class Coordinator:
 
     def __init__(self, config: CoordinatorConfig, sink=None):
         self.config = config
+        tdir = getattr(config, "TelemetryDir", "") or ""
+        if tdir:
+            # flight-recorder journal + dump-on-fault directory
+            # (runtime/telemetry.py; off by default — memory-only ring)
+            RECORDER.configure(
+                journal_path=os.path.join(
+                    tdir, "coordinator.telemetry.jsonl"
+                ),
+                dump_dir=tdir,
+            )
         self.tracer = make_tracer(
             "coordinator", config.TracerServerAddr, config.TracerSecret,
             sink=sink,

@@ -70,6 +70,7 @@ from typing import Optional
 from ..runtime import actions as act
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.rpc import RPCClient, RPCError, RPCTransportError
+from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, encode_token
 
 log = logging.getLogger("distpow.powlib")
@@ -253,6 +254,8 @@ class POW:
             old, self.coordinator = self.coordinator, fresh
             self._conn_gen += 1
             metrics.inc("powlib.reconnects")
+            RECORDER.record("powlib.reconnect", addr=self.coord_addr,
+                            gen=self._conn_gen)
             log.info("reconnected to coordinator at %s (gen %d)",
                      self.coord_addr, self._conn_gen)
         try:
@@ -286,6 +289,9 @@ class POW:
                 attempt += 1
                 if budget <= 0 or attempt >= attempts_cap:
                     metrics.inc("powlib.degraded")
+                    RECORDER.record("powlib.degraded", nonce=nonce.hex(),
+                                    ntz=ntz, attempts=attempt,
+                                    error=str(exc))
                     raise _MineFailed(
                         f"degraded: mine RPC failed after {attempt} "
                         f"attempt(s) ({self.retries}-retry budget): {exc}"
@@ -304,6 +310,7 @@ class POW:
                 raise _MineFailed(str(exc))
 
     def _call_mine(self, tracer, nonce, num_trailing_zeros, trace) -> None:
+        t0 = time.monotonic()
         try:
             trace.record_action(
                 act.PowlibMine(nonce=nonce, num_trailing_zeros=num_trailing_zeros)
@@ -324,6 +331,9 @@ class POW:
                 return
             if result is None:  # closed mid-call
                 return
+            # client-observed mine round-trip, retries and backoff
+            # included — the end-to-end latency a caller actually waits
+            metrics.observe("powlib.mine_s", time.monotonic() - t0)
             token = decode_token(result["token"])
             result_trace = tracer.receive_token(token)
             mr = MineResult(

@@ -34,8 +34,10 @@ Results leave through a queue drained by a forwarder thread issuing async
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from ..backends import get_backend
@@ -45,6 +47,7 @@ from ..runtime.cache import ResultCache
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import WorkerConfig
 from ..runtime.rpc import RPCClient, RPCServer
+from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
 from ..runtime.watchdog import WATCHDOG
 
@@ -134,8 +137,22 @@ class WorkerRPCHandler:
         self.result_cache = ResultCache(persist_path=cache_file or None)
         self._tasks: Dict[TaskKey, TaskRound] = {}
         self._tasks_lock = threading.Lock()
+        # miner threads currently inside backend.search — the
+        # admission-control contention signal (VERDICT r5 weak #4:
+        # measure the multi-request pile-up before designing the fix)
+        self._active_searches = 0
+
+    def _searches_delta(self, d: int) -> None:
+        with self._tasks_lock:
+            self._active_searches += d
+            # gauge published under the same lock that computed it: two
+            # threads publishing outside would let a stale count
+            # overwrite a fresher one and stick (review PR 3)
+            metrics.gauge("worker.active_searches", self._active_searches)
 
     # -- task table (worker.go:403-421) -----------------------------------
+    # every mutation re-gauges worker.mine_queue_depth, so the reading
+    # tracks the LIVE depth, not the high-water mark (review PR 3)
     def _task_set(self, key: TaskKey, round_: TaskRound) -> None:
         with self._tasks_lock:
             stale = self._tasks.get(key)
@@ -147,10 +164,13 @@ class WorkerRPCHandler:
                 stale.superseded = True
                 stale.ev.set()
             self._tasks[key] = round_
+            metrics.gauge("worker.mine_queue_depth", len(self._tasks))
 
     def _task_pop(self, key: TaskKey) -> Optional[TaskRound]:
         with self._tasks_lock:
-            return self._tasks.pop(key, None)
+            out = self._tasks.pop(key, None)
+            metrics.gauge("worker.mine_queue_depth", len(self._tasks))
+            return out
 
     def _task_take(self, key: TaskKey, rid) -> Optional[TaskRound]:
         """Pop the active round for ``key`` given a Found tagged ``rid``.
@@ -177,9 +197,11 @@ class WorkerRPCHandler:
                 return None
             if rid is None or cur.round_id is None or cur.round_id == rid:
                 del self._tasks[key]
+                metrics.gauge("worker.mine_queue_depth", len(self._tasks))
                 return cur
             if _rid_order(rid) > _rid_order(cur.round_id):
                 del self._tasks[key]
+                metrics.gauge("worker.mine_queue_depth", len(self._tasks))
                 cur.superseded = True
                 cur.ev.set()
             return None
@@ -269,6 +291,9 @@ class WorkerRPCHandler:
                 "token": encode_token(trace.generate_token()),
             }
         )
+        # forwarder backlog: grows when the coordinator is slow/away
+        # (qsize is advisory under concurrency — a gauge, not a ledger)
+        metrics.gauge("worker.forward_queue_depth", self.result_queue.qsize())
 
     def _finish_found(self, key: TaskKey, secret: bytes, round_: TaskRound,
                       trace) -> None:
@@ -295,6 +320,7 @@ class WorkerRPCHandler:
     def _mine(self, key: TaskKey, worker_bits: int, round_: TaskRound,
               trace) -> None:
         nonce, ntz, worker_byte = key
+        t0 = time.monotonic()
         cached = self.result_cache.get(nonce, ntz, trace)
         if cached is not None:
             self._finish_found(key, cached, round_, trace)
@@ -311,17 +337,29 @@ class WorkerRPCHandler:
                     or self.result_cache.satisfies(nonce, ntz) is not None)
 
         tbs = partition.thread_bytes(worker_byte, worker_bits)
-        secret = self.backend.search(
-            nonce, ntz, tbs, cancel_check=cancel_check
-        )
+        self._searches_delta(+1)
+        try:
+            secret = self.backend.search(
+                nonce, ntz, tbs, cancel_check=cancel_check
+            )
+        finally:
+            self._searches_delta(-1)
         if round_.superseded:
             # a newer Mine owns this key now; anything we emit would be
             # mis-attributed to its round (see TaskRound) — exit silently
             return
         if secret is not None:
+            # a REAL device solve (cache replays return above): this is
+            # the worker-side latency distribution of the paper's race
+            metrics.observe("worker.solve_s", time.monotonic() - t0)
             self._finish_found(key, secret, round_, trace)
             return
-        if not round_.ev.is_set():
+        if round_.ev.is_set():
+            # cancelled by a Found/Cancel RPC: Mine receipt -> honored
+            # cancellation, the per-worker half of cancel propagation
+            metrics.observe("worker.time_to_cancel_s",
+                            time.monotonic() - t0)
+        else:
             cached = self.result_cache.get(nonce, ntz, None)
             if cached is not None:
                 # cache-triggered stop: deliver the cached secret as this
@@ -359,6 +397,16 @@ class Worker:
             from ..runtime.compile_cache import enable as enable_compile_cache
 
             enable_compile_cache(config.CompilationCacheDir)
+        tdir = getattr(config, "TelemetryDir", "") or ""
+        if tdir:
+            # flight-recorder journal + dump-on-fault directory
+            # (runtime/telemetry.py; off by default — memory-only ring)
+            RECORDER.configure(
+                journal_path=os.path.join(
+                    tdir, f"{config.WorkerID}.telemetry.jsonl"
+                ),
+                dump_dir=tdir,
+            )
         self.tracer = make_tracer(
             config.WorkerID, config.TracerServerAddr, config.TracerSecret,
             sink=sink,
@@ -444,6 +492,8 @@ class Worker:
             backoff = 0.2
             while True:
                 res = self.result_queue.get()
+                metrics.gauge("worker.forward_queue_depth",
+                              self.result_queue.qsize())
                 if res is None:
                     return
                 while not self._stopping.is_set():
@@ -455,6 +505,12 @@ class Worker:
                         break
                     except Exception as exc:
                         metrics.inc("worker.forward_retries")
+                        RECORDER.record(
+                            "worker.forward_retry",
+                            worker=self.config.WorkerID,
+                            queue_depth=self.result_queue.qsize(),
+                            error=str(exc),
+                        )
                         log.warning(
                             "%s: result delivery failed (%s); re-dialing "
                             "coordinator in %.1fs",

@@ -35,6 +35,7 @@ re-verified host-side with hashlib before being returned.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence, Tuple
@@ -131,6 +132,52 @@ class SearchResult:
     hashes_tried: int
 
 
+class _RateMeter:
+    """Process-wide live-throughput meter behind ``search.hashes_per_s``.
+
+    One shared meter, not per-search state: concurrent searches all
+    drain the same device, so the meaningful rate is candidates drained
+    per wall-clock interval ACROSS searches (per-search EMAs writing one
+    gauge would interleave garbage).  EMA over drain-to-drain windows
+    smooths tunnel jitter; when the last active search exits the gauge
+    drops to 0 — a stale full-throughput reading on an idle worker is
+    the stuck-gauge class this plane polices elsewhere (review PR 3).
+    """
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._active = 0
+        self._last_t: Optional[float] = None
+        self._ema: Optional[float] = None
+
+    def enter(self) -> None:
+        with self._lock:
+            self._active += 1
+
+    def exit(self) -> None:
+        with self._lock:
+            self._active -= 1
+            if self._active <= 0:
+                self._last_t = self._ema = None
+                metrics.gauge("search.hashes_per_s", 0)
+
+    def note(self, n_cand: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            prev, self._last_t = self._last_t, now
+            if prev is None or now <= prev:
+                return
+            inst = n_cand / (now - prev)
+            self._ema = inst if self._ema is None else \
+                0.7 * self._ema + 0.3 * inst
+            metrics.gauge("search.hashes_per_s", round(self._ema, 3))
+
+
+_RATE_METER = _RateMeter()
+
+
 # canonical home is the jax-free partition module (advisor r3: the
 # native backend validates runs without importing the JAX compute path);
 # re-exported here because the driver and both device backends import it
@@ -217,8 +264,6 @@ def search(
                 f"and no cancel_check/max_hashes gate was supplied; the "
                 f"search could never return"
             )
-        import time
-
         # (no watchdog involvement: this loop never touches the device,
         # and beating here could mask a genuinely hung concurrent search
         # on the shared staleness clock)
@@ -243,7 +288,14 @@ def search(
         res, chunk0, vw, extra, n_cand = inflight.popleft()
         hashes += n_cand
         metrics.inc("search.hashes", n_cand)
+        # the sanctioned host sync: time blocked on the launch's result
+        # fetch — the per-launch latency distribution (pipelined, so a
+        # busy pipeline shows near-zero waits; a dry one shows the full
+        # device+tunnel round trip)
+        fetch_t0 = time.monotonic()
         f = int(res)
+        metrics.observe("search.launch_s", time.monotonic() - fetch_t0)
+        _RATE_METER.note(n_cand)
         if f == SENTINEL:
             return None
         chunk_int = (chunk0 + f // tbc) & 0xFFFFFFFF
@@ -284,65 +336,75 @@ def search(
     # The active() window covers every dispatch and drain: if the device
     # hangs mid-search, beats stop and the watchdog (if the worker
     # enabled it — WorkerConfig.DeviceHangTimeoutS) converts the zombie
-    # into a visible process death (runtime/watchdog.py).
-    with WATCHDOG.active():
-        for width in range(0, max_width + 1):
-            for vw, lo, hi, extra in width_segments(width):
-                WATCHDOG.beat()  # factory may compile (bounded, legit gap)
-                k = launch_steps_for(vw, target_chunks, tbc, launch_candidates)
-                step, chunks_per_step = factory(vw, extra, target_chunks, k)
-                chunk0 = lo
-                while chunk0 < hi:
-                    # A launch's compiled span can overshoot the
-                    # segment end (the chunk count is a compile-time
-                    # shape; the tail launch is not re-compiled
-                    # smaller).  Overshot chunk ints alias back into
-                    # already-covered candidates via the width mask —
-                    # harmless for first-hit order (an aliased hit
-                    # implies an equal in-launch or already-scanned
-                    # hit) — but they are NOT searched work: count only
-                    # the in-segment candidates, or hashes_tried /
-                    # search.hashes inflate by orders of magnitude on
-                    # small partitions and max_hashes budgets misfire
-                    # (found by the round-4 differential fuzz: a
-                    # [240,241] partition reported 16.7M hashes for a
-                    # 4.8k-candidate solve).
-                    n_cand = min(chunks_per_step, hi - chunk0) * tbc
-                    WATCHDOG.beat()
-                    if cancel_check is not None and cancel_check():
-                        flush_inflight_counts()
-                        metrics.inc("search.cancelled")
-                        return None
-                    if max_hashes is not None and hashes >= max_hashes:
-                        found = drain_all()
-                        if found is not None:
-                            metrics.inc("search.found")
-                        return found
-                    if chunk0 == lo:
-                        # the segment's FIRST launch pays the compile
-                        # when the layout cache is cold (an unwarmed
-                        # width or model): one uninterruptible gap that
-                        # can far exceed the hang timeout for the
-                        # biggest graphs (sha512 unrolled: >22 min
-                        # observed on the tunnel) — widen the window
-                        # for just this launch so an armed watchdog
-                        # does not kill a healthy worker mid-compile
-                        with WATCHDOG.grace(FIRST_COMPILE_GRACE_S):
-                            res = step(chunk0 & 0xFFFFFFFF)
-                    else:
-                        res = step(chunk0 & 0xFFFFFFFF)
-                    metrics.inc("search.launches")
-                    inflight.append((res, chunk0, vw, extra, n_cand))
-                    chunk0 += chunks_per_step
-                    if len(inflight) >= pipeline_depth:
-                        found = drain_one()
-                        if found is not None:
+    # into a visible process death (runtime/watchdog.py).  The rate
+    # meter brackets the same window: its refcount zeroes the
+    # hashes_per_s gauge when the LAST concurrent search exits, on
+    # every exit path (found / cancelled / budget / error).
+    _RATE_METER.enter()
+    try:
+        with WATCHDOG.active():
+            for width in range(0, max_width + 1):
+                for vw, lo, hi, extra in width_segments(width):
+                    WATCHDOG.beat()  # factory may compile (bounded gap)
+                    k = launch_steps_for(vw, target_chunks, tbc,
+                                         launch_candidates)
+                    step, chunks_per_step = factory(vw, extra,
+                                                    target_chunks, k)
+                    chunk0 = lo
+                    while chunk0 < hi:
+                        # A launch's compiled span can overshoot the
+                        # segment end (the chunk count is a compile-time
+                        # shape; the tail launch is not re-compiled
+                        # smaller).  Overshot chunk ints alias back into
+                        # already-covered candidates via the width mask —
+                        # harmless for first-hit order (an aliased hit
+                        # implies an equal in-launch or already-scanned
+                        # hit) — but they are NOT searched work: count
+                        # only the in-segment candidates, or hashes_tried
+                        # / search.hashes inflate by orders of magnitude
+                        # on small partitions and max_hashes budgets
+                        # misfire (found by the round-4 differential
+                        # fuzz: a [240,241] partition reported 16.7M
+                        # hashes for a 4.8k-candidate solve).
+                        n_cand = min(chunks_per_step, hi - chunk0) * tbc
+                        WATCHDOG.beat()
+                        if cancel_check is not None and cancel_check():
                             flush_inflight_counts()
-                            metrics.inc("search.found")
+                            metrics.inc("search.cancelled")
+                            return None
+                        if max_hashes is not None and hashes >= max_hashes:
+                            found = drain_all()
+                            if found is not None:
+                                metrics.inc("search.found")
                             return found
-                found = drain_all()
-                if found is not None:
-                    flush_inflight_counts()
-                    metrics.inc("search.found")
-                    return found
-    return None
+                        if chunk0 == lo:
+                            # the segment's FIRST launch pays the compile
+                            # when the layout cache is cold (an unwarmed
+                            # width or model): one uninterruptible gap
+                            # that can far exceed the hang timeout for
+                            # the biggest graphs (sha512 unrolled:
+                            # >22 min observed on the tunnel) — widen the
+                            # window for just this launch so an armed
+                            # watchdog does not kill a healthy worker
+                            # mid-compile
+                            with WATCHDOG.grace(FIRST_COMPILE_GRACE_S):
+                                res = step(chunk0 & 0xFFFFFFFF)
+                        else:
+                            res = step(chunk0 & 0xFFFFFFFF)
+                        metrics.inc("search.launches")
+                        inflight.append((res, chunk0, vw, extra, n_cand))
+                        chunk0 += chunks_per_step
+                        if len(inflight) >= pipeline_depth:
+                            found = drain_one()
+                            if found is not None:
+                                flush_inflight_counts()
+                                metrics.inc("search.found")
+                                return found
+                    found = drain_all()
+                    if found is not None:
+                        flush_inflight_counts()
+                        metrics.inc("search.found")
+                        return found
+        return None
+    finally:
+        _RATE_METER.exit()

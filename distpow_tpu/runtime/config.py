@@ -100,6 +100,11 @@ class CoordinatorConfig:
     # Deterministic fault-injection plan (runtime/faults.py); empty = no
     # injection.  Also reachable via $DISTPOW_FAULTS and --faults.
     FaultPlanFile: str = ""
+    # Flight-recorder directory (runtime/telemetry.py): periodic
+    # append-only JSONL journal of recent annotated events plus
+    # dump-on-fault snapshots land here.  Empty = memory-only ring.
+    # Also reachable via $DISTPOW_TELEMETRY_DIR.
+    TelemetryDir: str = ""
 
 
 @dataclass
@@ -163,6 +168,11 @@ class WorkerConfig:
     # Deterministic fault-injection plan (runtime/faults.py); empty = no
     # injection.  Also reachable via $DISTPOW_FAULTS and --faults.
     FaultPlanFile: str = ""
+    # Flight-recorder directory (runtime/telemetry.py): periodic
+    # append-only JSONL journal of recent annotated events plus
+    # dump-on-fault snapshots land here.  Empty = memory-only ring.
+    # Also reachable via $DISTPOW_TELEMETRY_DIR.
+    TelemetryDir: str = ""
 
 
 @dataclass

@@ -72,6 +72,7 @@ from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 from .metrics import REGISTRY as metrics
+from .telemetry import RECORDER
 
 log = logging.getLogger("distpow.faults")
 
@@ -179,6 +180,12 @@ class FaultPlan:
                 self._fired[ri] += 1
                 self.injected.append((ri, rule.kind, side, method, idx))
                 metrics.inc(f"faults.injected.{rule.kind}")
+                # the flight recorder is the chaos run's evidence trail:
+                # a post-mortem dump carries exactly which faults hit
+                # which frames, in order (runtime/telemetry.py)
+                RECORDER.record("fault.injected", fault=rule.kind,
+                                side=side, method=method, peer=peer,
+                                rule=ri, call=idx)
                 log.info("fault injected: %s %s %s peer=%s (rule %d, call %d)",
                          rule.kind, side, method, peer, ri, idx)
                 return rule.kind, self._delay_of(rule, ri, idx)

@@ -2,10 +2,13 @@
 reference's only observability is its tracing subsystem; SURVEY.md
 section 5 "Metrics: no counters").
 
-A deliberately tiny, dependency-free counter/gauge registry.  Every node
-process has one ``REGISTRY``; hot paths increment named counters and the
-node's ``Stats`` RPC ships a snapshot (see nodes/coordinator.py and
-nodes/worker.py; ``python -m distpow_tpu.cli.stats`` prints it).
+A deliberately tiny, dependency-free counter/gauge/histogram registry.
+Every node process has one ``REGISTRY``; hot paths increment named
+counters, set gauges, and observe latency/size samples into
+log-bucketed histograms; the node's ``Stats`` RPC ships a snapshot (see
+nodes/coordinator.py and nodes/worker.py; ``python -m
+distpow_tpu.cli.stats`` prints it, ``--prom`` renders Prometheus text
+exposition — docs/METRICS.md is the catalog).
 
 Counter names in use (machine-checked: ``KNOWN_COUNTERS`` below is the
 declaration distpow-lint's ``metrics-registry`` rule verifies every
@@ -33,18 +36,41 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
   (runtime/compile_cache.py)
 * ``faults.injected.<kind>`` — fault-injection plane activity
   (runtime/faults.py; kind in refuse/delay/truncate/duplicate/drop)
+* ``telemetry.dropped_events`` / ``telemetry.dumps`` — flight-recorder
+  ring overwrites and dump-on-fault snapshots (runtime/telemetry.py)
+
+Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
+``KNOWN_HISTOGRAM_PREFIXES`` vs ``observe()``/``time()`` call sites):
+
+* ``coord.mine_s.hit`` / ``coord.mine_s.miss`` — Mine RPC end-to-end
+  latency split by dominance-cache outcome (nodes/coordinator.py)
+* ``coord.first_result_s``       — fan-out to first worker result
+* ``coord.cancel_propagation_s`` — fan-out to last cancellation ACK
+* ``worker.solve_s``          — backend search latency for found secrets
+* ``worker.time_to_cancel_s`` — Mine receipt to honored cancellation
+* ``search.launch_s``  — time blocked fetching one launch's result
+  (the driver's FIFO drain; parallel/search.py)
+* ``powlib.mine_s``    — client-observed mine round-trip incl. retries
+* ``rpc.frame.sent_bytes`` / ``rpc.frame.recv_bytes`` — wire frame sizes
+* ``rpc.client.call_s.<Service.Method>``     — per-method round-trip
+* ``rpc.server.dispatch_s.<Service.Method>`` — per-method handler time
+
+Gauges (not lint-gated — gauges are set, never minted by typo'd
+increments): ``worker.active_searches``, ``worker.mine_queue_depth``,
+``worker.forward_queue_depth``, ``search.hashes_per_s``.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
-from typing import Dict, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 Number = Union[int, float]
 
 # The declared counter registry.  distpow-lint's ``metrics-registry``
-# rule parses these two literals (AST, no import) and flags any
+# rule parses these literals (AST, no import) and flags any
 # ``metrics.inc``/``REGISTRY.inc`` call site whose literal name is not
 # declared here — a typo'd counter otherwise splits silently into a
 # real-but-frozen counter and a ghost twin nobody reads.  Keep the
@@ -61,6 +87,7 @@ KNOWN_COUNTERS = frozenset({
     "rpc.handler_errors",
     "compile_cache.errors", "compile_cache.read_errors",
     "compile_cache.write_errors", "compile_cache.keygen_errors",
+    "telemetry.dropped_events", "telemetry.dumps",
 })
 
 # Families minted from runtime values (f-string call sites): the
@@ -70,11 +97,137 @@ KNOWN_COUNTER_PREFIXES = frozenset({
     "search.",  # backends/__init__.py count_exit: search.{cancelled,found}
 })
 
+# The declared histogram registry — the same rule checks every
+# ``metrics.observe``/``metrics.time`` call site against these.
+KNOWN_HISTOGRAMS = frozenset({
+    "coord.mine_s.hit", "coord.mine_s.miss",
+    "coord.first_result_s", "coord.cancel_propagation_s",
+    "worker.solve_s", "worker.time_to_cancel_s",
+    "search.launch_s",
+    "powlib.mine_s",
+    "rpc.frame.sent_bytes", "rpc.frame.recv_bytes",
+})
+
+# Per-method families (runtime/rpc.py mints one histogram per
+# "Service.Method" seen on the wire).
+KNOWN_HISTOGRAM_PREFIXES = frozenset({
+    "rpc.client.call_s.",
+    "rpc.server.dispatch_s.",
+})
+
+# Log-bucket geometry: 4 buckets per octave (bounds grow by 2^0.25, so a
+# bucket is at most ~19% wide) — fine enough for honest p95/p99
+# estimates across the nine decades this registry spans (µs RPC
+# dispatches to multi-minute compiles; byte to multi-MB frames) at a
+# bounded, value-independent memory cost.
+_BUCKETS_PER_OCTAVE = 4
+_LOG_GROWTH = math.log(2.0) / _BUCKETS_PER_OCTAVE
+
+
+class Histogram:
+    """Log-bucketed distribution: count/sum/min/max plus percentile
+    ESTIMATES (each reported percentile is the upper bound of its
+    bucket, so estimates err high by at most one bucket width, ~19%).
+
+    Lock discipline: instances carry no lock of their own — the owning
+    :class:`Metrics` registry serializes ``observe`` under its single
+    registry lock, the same (cheap) critical section a counter
+    increment pays.
+    """
+
+    __slots__ = ("count", "sum", "min", "max", "_buckets", "_zeros")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._buckets: Dict[int, int] = {}  # log-bucket index -> count
+        self._zeros = 0  # non-positive samples (zero-latency clock ticks)
+
+    def observe(self, value: Number) -> None:
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        if v > 0.0:
+            idx = math.floor(math.log(v) / _LOG_GROWTH)
+            self._buckets[idx] = self._buckets.get(idx, 0) + 1
+        else:
+            self._zeros += 1
+
+    @staticmethod
+    def bound(idx: int) -> float:
+        """Upper bound of log-bucket ``idx``."""
+        return math.exp((idx + 1) * _LOG_GROWTH)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (q in [0, 1]); None when empty."""
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        cum = self._zeros
+        if cum >= rank and self._zeros:
+            return 0.0
+        for idx in sorted(self._buckets):
+            cum += self._buckets[idx]
+            if cum >= rank:
+                est = self.bound(idx)
+                # the true sample lies inside the bucket; clamp the
+                # bucket-bound estimate to the observed extremes
+                return min(max(est, self.min or est), self.max or est)
+        return self.max
+
+    def to_dict(self) -> dict:
+        """JSON-able snapshot; ``buckets`` is ``[[upper_bound, count],
+        ...]`` in ascending bound order (non-cumulative — the Prometheus
+        renderer in cli/stats.py accumulates)."""
+        buckets: List[Tuple[float, int]] = []
+        if self._zeros:
+            buckets.append((0.0, self._zeros))
+        buckets.extend(
+            (round(self.bound(i), 9), self._buckets[i])
+            for i in sorted(self._buckets)
+        )
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 9),
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+            "buckets": [[b, c] for b, c in buckets],
+        }
+
+
+class _Timer:
+    """Context manager returned by :meth:`Metrics.time` — observes the
+    block's wall-clock duration (seconds) into the named histogram."""
+
+    __slots__ = ("_metrics", "_name", "_t0")
+
+    def __init__(self, metrics: "Metrics", name: str):
+        self._metrics = metrics
+        self._name = name
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Timer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._metrics.observe(self._name, time.monotonic() - self._t0)
+
 
 class Metrics:
     def __init__(self) -> None:
         self._counters: Dict[str, Number] = {}
         self._gauges: Dict[str, Number] = {}
+        self._hists: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
         self._start = time.time()
 
@@ -86,9 +239,28 @@ class Metrics:
         with self._lock:
             self._gauges[name] = value
 
+    def observe(self, name: str, value: Number) -> None:
+        """Add one sample to the named histogram (created on first
+        touch, like counters — distpow-lint polices the names)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(value)
+
+    def time(self, name: str) -> _Timer:
+        """``with metrics.time("x.y"): ...`` observes the block's
+        duration in seconds into histogram ``x.y``."""
+        return _Timer(self, name)
+
     def get(self, name: str) -> Number:
         with self._lock:
             return self._counters.get(name, self._gauges.get(name, 0))
+
+    def get_histogram(self, name: str) -> Optional[dict]:
+        with self._lock:
+            h = self._hists.get(name)
+            return h.to_dict() if h is not None else None
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -96,6 +268,9 @@ class Metrics:
                 "uptime_secs": round(time.time() - self._start, 3),
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
+                "histograms": {
+                    name: h.to_dict() for name, h in self._hists.items()
+                },
             }
 
     def reset(self) -> None:
@@ -103,6 +278,7 @@ class Metrics:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
             self._start = time.time()
 
 

@@ -62,11 +62,13 @@ def _read_frame(sock: socket.socket) -> dict:
     (length,) = struct.unpack(">I", _read_exact(sock, 4))
     if length > 64 * 1024 * 1024:
         raise RPCError(f"oversized frame: {length} bytes")
+    metrics.observe("rpc.frame.recv_bytes", length)
     return json.loads(_read_exact(sock, length).decode())
 
 
 def _write_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
     payload = json.dumps(obj).encode()
+    metrics.observe("rpc.frame.sent_bytes", len(payload))
     with lock:
         # distpow: ok no-blocking-under-lock -- this lock IS the frame
         # serializer: interleaved sendall from two threads would corrupt
@@ -207,7 +209,18 @@ class RPCServer:
             method = getattr(service, method_name, None)
             if method is None or not callable(method):
                 raise RPCError(f"unknown method {req['method']!r}")
-            result = method(req.get("params") or {})
+            # per-method handler latency: the distribution the ISSUE-3
+            # telemetry plane exists for — a slow Mine is invisible in
+            # counters alone.  Timed only once the method resolved, so
+            # adversarial method strings cannot mint histogram families.
+            t0 = time.monotonic()
+            try:
+                result = method(req.get("params") or {})
+            finally:
+                metrics.observe(
+                    f"rpc.server.dispatch_s.{service_name}.{method_name}",
+                    time.monotonic() - t0,
+                )
             resp = {"id": rid, "result": result, "error": None}
         except Exception as exc:  # handler errors travel to the caller
             metrics.inc("rpc.handler_errors")
@@ -385,6 +398,18 @@ class RPCClient:
             rid = self._next_id
             self._pending[rid] = fut
         req = {"id": rid, "method": method, "params": params or {}}
+        # round-trip latency per method, observed when the reader (or a
+        # teardown path) RESOLVES the future — success and error alike.
+        # A frame silently lost on a healthy connection (drop fault, or
+        # a peer that just never answers) has no completion to time and
+        # leaves no sample here; that outage surfaces in the caller-
+        # level histograms instead (powlib.mine_s spans its retries)
+        t0 = time.monotonic()
+        fut.add_done_callback(
+            lambda _f, _m=method, _t0=t0: metrics.observe(
+                f"rpc.client.call_s.{_m}", time.monotonic() - _t0
+            )
+        )
         duplicate = False
         if faults.PLAN is not None:
             hit = faults.PLAN.on_frame("client", method, self._addr)

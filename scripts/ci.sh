@@ -12,7 +12,12 @@
 # installed (`pip install -e .[lint]`; skipped with a note otherwise —
 # the gate itself is stdlib-only).  The fast/full lanes already enforce
 # distpow-lint via the un-slow `lint` marker.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint]
+# `--bench-rehearsal` runs the FULL outage-shaped bench (bench.py) on
+# the CPU platform against a temp provenance file — proving the bench
+# plumbing (stage order, anomaly screen, last_measured write) end to
+# end before the next hardware window, without touching the checked-in
+# hardware provenance (VERDICT r5 weak #3).  ~3-6 min of CPU compiles.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -40,6 +45,30 @@ if [ "${1:-}" = "--lint" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "--bench-rehearsal" ]; then
+  echo "=== bench rehearsal (CPU platform, temp provenance) ==="
+  tmp="$(mktemp -d)"
+  trap 'rm -rf "$tmp"' EXIT
+  BENCH_FORCE_PLATFORM=cpu \
+  BENCH_LAST_MEASURED_PATH="$tmp/last_measured.json" \
+  BENCH_DEADLINE_S="${BENCH_DEADLINE_S:-60}" \
+    python bench.py > "$tmp/bench_line.json"
+  python - "$tmp" <<'EOF'
+import json, os, sys
+tmp = sys.argv[1]
+line = json.load(open(os.path.join(tmp, "bench_line.json")))
+lm = json.load(open(os.path.join(tmp, "last_measured.json")))
+assert line.get("unit") == "MH/s" and line.get("value", 0) > 0, line
+assert lm.get("value", 0) > 0 and lm.get("rates_mhs"), lm
+assert lm.get("run_id", "").startswith("bench.py@"), lm
+print(f"[rehearsal] headline {line['value']} MH/s (cpu), "
+      f"{len(lm['rates_mhs'])} stage(s) in temp provenance, "
+      f"run_id={lm['run_id']}")
+EOF
+  echo "=== bench rehearsal OK ==="
+  exit 0
+fi
+
 echo "=== native miner build ==="
 make -C distpow_tpu/backends/native
 
@@ -54,7 +83,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal]" >&2
           exit 2 ;;
 esac
 

@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""trace_profile — critical-path profiler for distpow trace logs.
+
+    python scripts/trace_profile.py TRACE [--json]
+
+Reconstructs a per-Mine-request timeline from existing trace artifacts
+and prints where each request's time (logical or wall-clock) went:
+
+    queue -> fanout -> first result -> cancel storm -> done
+
+Accepted inputs (auto-detected):
+
+* **Golden / memory-sink JSON** (``tests/golden_trace.json`` shape):
+  ``{identity: [[trace_id, action, nonce_hex, ntz], ...]}`` — the
+  per-identity ordered action sequences a MemorySink captures.
+* **Human trace log** (``trace_output.log``, the FileSink /
+  tracing-server format): ``[identity] TraceID=n Action Field=value``
+  lines.
+* **Flight-recorder journal** (``*.telemetry.jsonl``,
+  runtime/telemetry.py): JSONL events carrying wall-clock ``ts`` —
+  per-round fanout / first-result / cancel-complete timings in seconds.
+
+Trace logs carry no timestamps (parity with the reference's tracing),
+so for the first two formats stage positions are **logical ticks**: the
+event's index in the coordinator's own ordered stream.  Ordering is
+what the protocol promises — queue <= fanout <= first-result <=
+cancel-complete — and a new tier-1 test pins exactly that invariant
+over the golden trace (tests/test_trace_profile.py).  The journal
+format upgrades the same stages to wall-clock seconds.
+
+Stage glossary (miss path):
+
+* ``queue``           — CoordinatorMine recorded (request accepted)
+* ``fanout``          — first CoordinatorWorkerMine (shards issued)
+* ``first_result``    — first CoordinatorWorkerResult (the race won)
+* ``cancel_complete`` — last CoordinatorWorkerCancel (storm drained)
+* ``done``            — CoordinatorSuccess (reply sent)
+* ``late_results``    — results landing after the winner: work the
+  cancellation failed to save (the wasted-post-result proxy a trace
+  can measure; hash counts ride in metrics, not traces)
+
+Cache hits short-circuit at ``queue`` (path="hit", no fanout stages).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+STAGES = ("queue", "fanout", "first_result", "cancel_complete", "done")
+
+_HUMAN_RX = re.compile(
+    r"^\[(?P<identity>[^\]]+)\]\s+TraceID=(?P<tid>\d+)\s+"
+    r"(?P<action>\w+)\s*(?P<body>.*)$"
+)
+_FIELD_RX = re.compile(r"(\w+)=(\[[^\]]*\]|\S+?)(?:,|$)")
+
+
+def _parse_human_line(line: str):
+    m = _HUMAN_RX.match(line.strip())
+    if m is None:
+        return None
+    fields = dict(_FIELD_RX.findall(m.group("body")))
+    nonce_hex = None
+    if "Nonce" in fields:
+        try:
+            nonce_hex = bytes(json.loads(fields["Nonce"])).hex()
+        except (ValueError, TypeError):
+            nonce_hex = fields["Nonce"]
+    ntz = None
+    if "NumTrailingZeros" in fields:
+        try:
+            ntz = int(fields["NumTrailingZeros"].rstrip(","))
+        except ValueError:
+            pass
+    return m.group("identity"), [int(m.group("tid")), m.group("action"),
+                                 nonce_hex, ntz]
+
+
+def load_events(path: str) -> Dict[str, List[list]]:
+    """Load any supported trace format into the golden shape:
+    identity -> ordered [trace_id, action, nonce_hex, ntz] lists."""
+    with open(path) as fh:
+        text = fh.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{") and not path.endswith(".jsonl"):
+        data = json.loads(text)
+        return {ident: [list(e) for e in evs] for ident, evs in data.items()}
+    out: Dict[str, List[list]] = {}
+    for line in text.splitlines():
+        parsed = _parse_human_line(line)
+        if parsed is None:
+            continue
+        ident, ev = parsed
+        out.setdefault(ident, []).append(ev)
+    if not out:
+        raise ValueError(
+            f"{path}: neither golden-JSON nor human trace lines found"
+        )
+    return out
+
+
+def profile_requests(events: Dict[str, List[list]]) -> List[dict]:
+    """Per-Mine-request critical path from per-identity ordered events.
+
+    Stage positions are indices into the COORDINATOR's own stream —
+    one node, one total order, so the stage inequalities are
+    well-defined without vector clocks."""
+    coord = None
+    for ident, evs in events.items():
+        if any(e[1] == "CoordinatorMine" for e in evs):
+            coord = ident
+            break
+    if coord is None:
+        return []
+    requests: List[dict] = []
+    by_tid: Dict[int, dict] = {}
+    for pos, (tid, action, nonce_hex, ntz) in enumerate(events[coord]):
+        if action == "CoordinatorMine":
+            # one trace can carry several Mines (a client reusing its
+            # trace); key on the open request per trace id
+            req = {
+                "trace_id": tid, "nonce": nonce_hex, "ntz": ntz,
+                "path": "miss",
+                "queue": pos, "fanout": None, "first_result": None,
+                "cancel_complete": None, "done": None,
+                "workers": 0, "results": 0, "late_results": 0,
+                "cancels": 0,
+            }
+            by_tid[tid] = req
+            requests.append(req)
+            continue
+        req = by_tid.get(tid)
+        if req is None or req["done"] is not None:
+            continue
+        if action == "CacheHit":
+            req["path"] = "hit"
+        elif action == "CoordinatorWorkerMine":
+            req["workers"] += 1
+            if req["fanout"] is None:
+                req["fanout"] = pos
+        elif action == "CoordinatorWorkerResult":
+            req["results"] += 1
+            if req["first_result"] is None:
+                req["first_result"] = pos
+            else:
+                req["late_results"] += 1
+        elif action == "CoordinatorWorkerCancel":
+            req["cancels"] += 1
+            req["cancel_complete"] = pos  # last one wins
+        elif action == "CoordinatorSuccess":
+            req["done"] = pos
+    return requests
+
+
+def profile_journal(path: str) -> List[dict]:
+    """Flight-recorder JSONL -> per-round wall-clock stage timings."""
+    rounds: Dict[str, dict] = {}
+    order: List[str] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            kind = ev.get("kind", "")
+            rid = ev.get("round")
+            if not kind.startswith("coord.") or rid is None:
+                continue
+            r = rounds.get(rid)
+            if r is None:
+                r = rounds[rid] = {"round": rid, "nonce": ev.get("nonce"),
+                                   "ntz": ev.get("ntz")}
+                order.append(rid)
+            if kind == "coord.fanout":
+                r["fanout_ts"] = ev.get("ts")
+            elif kind == "coord.first_result":
+                r["first_result_s"] = ev.get("latency_s")
+                r["winner_byte"] = ev.get("worker_byte")
+            elif kind == "coord.cancel_complete":
+                r["cancel_propagation_s"] = ev.get("latency_s")
+                r["late_results"] = ev.get("late_results")
+    return [rounds[rid] for rid in order]
+
+
+def format_request(req: dict) -> str:
+    head = (f"trace={req['trace_id']} nonce={req['nonce']} "
+            f"ntz={req['ntz']} path={req['path']}")
+    if req["path"] == "hit" or req["fanout"] is None:
+        return f"{head}  queue@{req['queue']} -> done@{req['done']} (cache)"
+    q = req["queue"]
+
+    def at(stage):
+        pos = req[stage]
+        return "-" if pos is None else f"@{pos}(+{pos - q})"
+
+    return (f"{head}  queue@{q} fanout{at('fanout')} "
+            f"first_result{at('first_result')} "
+            f"cancel_complete{at('cancel_complete')} done{at('done')}  "
+            f"workers={req['workers']} late_results={req['late_results']} "
+            f"cancels={req['cancels']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-Mine-request critical-path breakdown from traces"
+    )
+    ap.add_argument("trace", help="golden JSON, trace_output.log, or "
+                                  "flight-recorder .jsonl journal")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable request list on stdout")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.trace):
+        print(f"trace_profile: no such file: {args.trace}", file=sys.stderr)
+        return 2
+    if args.trace.endswith(".jsonl"):
+        rounds = profile_journal(args.trace)
+        if args.as_json:
+            print(json.dumps({"format": "journal", "rounds": rounds},
+                             indent=2))
+            return 0
+        print(f"# {len(rounds)} fan-out round(s) from {args.trace} "
+              f"(wall-clock seconds)")
+        for r in rounds:
+            print(f"round={r['round']} nonce={r.get('nonce')} "
+                  f"ntz={r.get('ntz')}  "
+                  f"first_result={r.get('first_result_s', '-')}s "
+                  f"cancel_propagation={r.get('cancel_propagation_s', '-')}s "
+                  f"late_results={r.get('late_results', 0)}")
+        return 0
+
+    try:
+        events = load_events(args.trace)
+    except ValueError as exc:
+        print(f"trace_profile: {exc}", file=sys.stderr)
+        return 2
+    requests = profile_requests(events)
+    misses = [r for r in requests if r["path"] == "miss"]
+    # a request with no CoordinatorSuccess is TRUNCATED (node killed /
+    # log captured mid-round — the crash-forensics case): missing later
+    # stages are expected there and are not a protocol violation.  A
+    # COMPLETED request with a missing or out-of-order stage is.
+    truncated = [r for r in misses if r["done"] is None]
+    violations = [
+        r for r in misses
+        if r["done"] is not None and (
+            None in (r["fanout"], r["first_result"], r["cancel_complete"])
+            or not (r["queue"] <= r["fanout"] <= r["first_result"]
+                    <= r["cancel_complete"])
+        )
+    ]
+    if args.as_json:
+        # same exit contract as the human mode: a consumer of the
+        # machine-readable output must not silently pass an ordering
+        # violation (review PR 3)
+        print(json.dumps({
+            "format": "trace",
+            "requests": requests,
+            "ordering_ok": not violations,
+            "violations": [r["trace_id"] for r in violations],
+            "truncated": [r["trace_id"] for r in truncated],
+        }, indent=2))
+        return 1 if violations else 0
+    print(f"# {len(requests)} Mine request(s) from {args.trace} "
+          f"({len(misses)} miss, {len(requests) - len(misses)} hit; "
+          f"positions are coordinator logical ticks)")
+    for req in requests:
+        print(format_request(req))
+    if truncated:
+        print(f"# note: {len(truncated)} request(s) truncated mid-round "
+              f"(no CoordinatorSuccess — log captured before the round "
+              f"finished); excluded from the ordering check")
+    if violations:
+        print(f"# ORDERING VIOLATION in {len(violations)} request(s): "
+              f"expected queue <= fanout <= first_result <= cancel_complete",
+              file=sys.stderr)
+        return 1
+    print("# stage ordering OK: queue <= fanout <= first_result <= "
+          "cancel_complete for every completed miss")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-"""Fixture: metrics-registry must flag undeclared counter names."""
+"""Fixture: metrics-registry must flag undeclared series names."""
 
 from distpow_tpu.runtime.metrics import REGISTRY as metrics
 from distpow_tpu.runtime.metrics import REGISTRY
@@ -6,7 +6,10 @@ from distpow_tpu.runtime.metrics import REGISTRY
 GHOST = "coord.phantom_counter"
 
 
-def hot_path(kind):
+def hot_path(kind, dt):
     metrics.inc("coord.fanout")  # line 10: typo of coord.fanouts
     REGISTRY.inc(GHOST)  # line 11: resolvable constant, undeclared
     metrics.inc(f"mystery.{kind}")  # line 12: undeclared prefix
+    metrics.observe("worker.solve", dt)  # line 13: typo of worker.solve_s
+    with metrics.time(f"rpc.mystery_s.{kind}"):  # line 14: bad prefix
+        pass

@@ -1,14 +1,21 @@
-"""Fixture: declared counters metrics-registry must accept."""
+"""Fixture: declared series names metrics-registry must accept."""
 
 from distpow_tpu.runtime.metrics import REGISTRY as metrics
 
 TOTAL = "compile_cache.errors"
+SOLVE_HIST = "worker.solve_s"
 
 
-def hot_path(kind, dynamic_name):
+def hot_path(kind, dt, dynamic_name):
     metrics.inc("coord.fanouts")
     metrics.inc("search.hashes", 1024)
     metrics.inc(TOTAL)
     metrics.inc(f"faults.injected.{kind}")
+    metrics.observe("coord.first_result_s", dt)
+    metrics.observe(SOLVE_HIST, dt)
+    metrics.observe(f"rpc.client.call_s.{kind}", dt)
+    with metrics.time("powlib.mine_s"):
+        pass
     # fully dynamic names are a documented limitation, not a finding
     metrics.inc(dynamic_name)
+    metrics.observe(dynamic_name, dt)

@@ -57,27 +57,35 @@ def test_package_tree_has_zero_unsuppressed_findings():
 
 
 def test_context_parsed_from_real_declarations():
-    # 16 reference-parity action types, the full counter registry, and
-    # the config dataclass fields all parse out of the package source
+    # 16 reference-parity action types, the full counter AND histogram
+    # registries, and the config dataclass fields all parse out of the
+    # package source
     assert len(CTX.action_names) == 16
     assert "CoordinatorWorkerResult" in CTX.action_names
     assert "coord.stale_results_dropped" in CTX.counters
     assert "faults.injected." in CTX.counter_prefixes
-    assert {"Backend", "CacheFile", "MineRetries"} <= CTX.config_fields
+    assert "coord.mine_s.miss" in CTX.histograms
+    assert "worker.solve_s" in CTX.histograms
+    assert "rpc.client.call_s." in CTX.histogram_prefixes
+    assert "rpc.server.dispatch_s." in CTX.histogram_prefixes
+    assert {"Backend", "CacheFile", "MineRetries",
+            "TelemetryDir"} <= CTX.config_fields
 
 
-def test_known_counters_documented():
-    """Every declared counter appears in the metrics.py docstring — the
-    human registry and the machine registry must not drift."""
+def test_known_series_documented():
+    """Every declared counter and histogram appears in the metrics.py
+    docstring — the human registry and the machine registry must not
+    drift."""
     import distpow_tpu.runtime.metrics as m
 
     doc = m.__doc__ or ""
-    missing = sorted(
-        c for c in m.KNOWN_COUNTERS
-        if c not in doc and f"``.{c.split('.', 1)[1]}" not in doc
-        and c.split(".", 1)[1] not in doc
-    )
-    assert not missing, f"counters undeclared in docstring: {missing}"
+    for declared in (m.KNOWN_COUNTERS, m.KNOWN_HISTOGRAMS):
+        missing = sorted(
+            c for c in declared
+            if c not in doc and f"``.{c.split('.', 1)[1]}" not in doc
+            and c.split(".", 1)[1] not in doc
+        )
+        assert not missing, f"series undeclared in docstring: {missing}"
 
 
 # -- every rule fires on its bad fixture and passes its clean one ------------
@@ -88,7 +96,7 @@ CASES = [
     ("trace-vocabulary", "trace_vocabulary_bad.py",
      "trace_vocabulary_ok.py", 3),
     ("metrics-registry", "metrics_registry_bad.py",
-     "metrics_registry_ok.py", 3),
+     "metrics_registry_ok.py", 5),
     ("config-key-sync", "config_key_sync_bad.py",
      "config_key_sync_ok.py", 3),
     ("hot-path-host-sync", os.path.join("ops", "hot_path_host_sync_bad.py"),

@@ -1,16 +1,28 @@
 """Metrics subsystem tests: counters land during a real protocol run and
 the Stats RPC / CLI expose them (capability absent in the reference,
-SURVEY.md section 5)."""
+SURVEY.md section 5); ISSUE 3 adds the histogram plane — log-bucketed
+latency distributions, Stats round-trip preservation, and the
+Prometheus text exposition."""
 
+import re
 import sys
+import threading
+import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).parent))
 
 from test_nodes import Stack, mine_and_wait  # noqa: E402
 
-from distpow_tpu.cli.stats import fetch_stats  # noqa: E402
-from distpow_tpu.runtime.metrics import REGISTRY, Metrics  # noqa: E402
+from distpow_tpu.cli.stats import (  # noqa: E402
+    fetch_stats,
+    render_prometheus,
+)
+from distpow_tpu.runtime.metrics import (  # noqa: E402
+    REGISTRY,
+    Histogram,
+    Metrics,
+)
 
 
 def test_metrics_registry_basics():
@@ -24,6 +36,134 @@ def test_metrics_registry_basics():
     assert snap["uptime_secs"] >= 0
     m.reset()
     assert m.snapshot()["counters"] == {}
+
+
+# ---------------------------------------------------------------------------
+# histograms (ISSUE 3 tentpole)
+# ---------------------------------------------------------------------------
+
+def test_histogram_bucket_edges():
+    h = Histogram()
+    for v in (0.0, 1e-6, 0.001, 1.0, 1.0, 2.0, 1000.0):
+        h.observe(v)
+    d = h.to_dict()
+    assert d["count"] == 7
+    assert d["min"] == 0.0 and d["max"] == 1000.0
+    assert abs(d["sum"] - (1e-6 + 0.001 + 1.0 + 1.0 + 2.0 + 1000.0)) < 1e-9
+    bounds = [b for b, _ in d["buckets"]]
+    counts = [c for _, c in d["buckets"]]
+    assert bounds == sorted(bounds), "bucket bounds must ascend"
+    assert sum(counts) == d["count"]
+    # the zero sample lands in the dedicated le=0 bucket
+    assert bounds[0] == 0.0 and counts[0] == 1
+    # every positive sample sits at or below its bucket's upper bound,
+    # and each bound is within one log-step (~19%) above SOME sample:
+    # 1.0 was observed twice — both land in the same bucket
+    one_bucket = [c for b, c in d["buckets"] if b >= 1.0][0]
+    assert one_bucket == 2
+
+
+def test_histogram_percentile_estimates():
+    h = Histogram()
+    for v in range(1, 101):  # uniform 1..100
+        h.observe(float(v))
+    # log-bucket estimates err high by at most one bucket width (~19%)
+    p50, p95, p99 = (h.percentile(q) for q in (0.50, 0.95, 0.99))
+    assert 45 <= p50 <= 62, p50
+    assert 88 <= p95 <= 100, p95
+    assert 94 <= p99 <= 100, p99  # clamped to the observed max
+    assert h.percentile(1.0) == 100.0
+    assert Histogram().percentile(0.5) is None
+
+
+def test_histogram_concurrent_observe():
+    m = Metrics()
+    per_thread, n_threads = 1000, 8
+
+    def worker():
+        for i in range(per_thread):
+            m.observe("h", (i % 10) + 1)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    d = m.get_histogram("h")
+    assert d["count"] == per_thread * n_threads
+    assert d["sum"] == n_threads * sum((i % 10) + 1 for i in range(per_thread))
+    assert d["min"] == 1 and d["max"] == 10
+
+
+def test_metrics_time_context_manager():
+    m = Metrics()
+    with m.time("op_s"):
+        time.sleep(0.02)
+    d = m.get_histogram("op_s")
+    assert d["count"] == 1
+    assert 0.01 <= d["sum"] <= 5.0
+
+
+def test_histogram_snapshot_and_reset():
+    m = Metrics()
+    m.observe("h", 1.5)
+    snap = m.snapshot()
+    assert snap["histograms"]["h"]["count"] == 1
+    # snapshot is a copy: later observes don't mutate it
+    m.observe("h", 2.5)
+    assert snap["histograms"]["h"]["count"] == 1
+    m.reset()
+    assert m.snapshot()["histograms"] == {}
+    assert m.get_histogram("h") is None
+
+
+PROM_SAMPLE_RX = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+\-]+(inf)?$",
+    re.IGNORECASE,
+)
+
+
+def assert_valid_prometheus(text: str) -> None:
+    """Every non-comment line must be a well-formed sample; every
+    histogram family must be cumulative and closed by +Inf == count."""
+    families = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*", line)
+            assert m, f"malformed comment: {line!r}"
+            continue
+        assert PROM_SAMPLE_RX.match(line), f"malformed sample: {line!r}"
+        name = line.split("{")[0].split(" ")[0]
+        families.setdefault(name, []).append(line)
+    for name, lines in families.items():
+        if not name.endswith("_bucket"):
+            continue
+        base = name[: -len("_bucket")]
+        cum = [float(l.rsplit(" ", 1)[1]) for l in lines]
+        assert cum == sorted(cum), f"{name} buckets not cumulative"
+        count = float(families[base + "_count"][0].rsplit(" ", 1)[1])
+        assert cum[-1] == count, f"{name} +Inf != _count"
+
+
+def test_render_prometheus_shape():
+    m = Metrics()
+    m.inc("coord.mine_rpcs", 3)
+    m.gauge("worker.active_searches", 2)
+    m.observe("coord.mine_s.miss", 0.25)
+    m.observe("coord.mine_s.miss", 0.5)
+    snap = m.snapshot()
+    snap["role"] = "coordinator"
+    text = render_prometheus(snap)
+    assert_valid_prometheus(text)
+    assert 'distpow_node_info{role="coordinator"} 1' in text
+    assert "# TYPE distpow_coord_mine_rpcs_total counter" in text
+    assert "distpow_coord_mine_rpcs_total 3" in text
+    assert "# TYPE distpow_worker_active_searches gauge" in text
+    assert "# TYPE distpow_coord_mine_s_miss histogram" in text
+    assert "distpow_coord_mine_s_miss_count 2" in text
+    assert 'distpow_coord_mine_s_miss_bucket{le="+Inf"} 2' in text
 
 
 def test_stats_rpc_and_cli_after_protocol_run():
@@ -50,6 +190,26 @@ def test_stats_rpc_and_cli_after_protocol_run():
         assert delta("cache.add") >= 1
         assert delta("worker.mine_rpcs") >= 2   # in-process: shared registry
         assert delta("worker.results_sent") >= 4
+
+        # the Stats RPC round-trips full histogram snapshots: one from
+        # each node role of the request path (shared in-process
+        # registry, so the coordinator snapshot carries all three)
+        hists = coord_stats["histograms"]
+        assert hists["coord.mine_s.miss"]["count"] >= 1
+        assert hists["coord.mine_s.hit"]["count"] >= 1
+        assert hists["coord.first_result_s"]["count"] >= 1
+        assert hists["coord.cancel_propagation_s"]["count"] >= 1
+        assert hists["worker.solve_s"]["count"] >= 1
+        assert hists["powlib.mine_s"]["count"] >= 1
+        assert hists["rpc.server.dispatch_s.CoordRPCHandler.Mine"][
+            "count"] >= 2
+        for h in hists.values():
+            # JSON round-trip preserved the full estimator state
+            assert set(h) >= {"count", "sum", "min", "max",
+                              "p50", "p95", "p99", "buckets"}
+            if h["count"]:
+                assert h["p50"] is not None
+                assert h["min"] <= h["p50"] <= h["max"]
 
         worker_stats = fetch_stats(s.workers[0].bound_addr, role="worker")
         assert worker_stats["role"] == "worker"
@@ -97,5 +257,46 @@ def test_stats_cli_main(capsys):
         assert main(["--addr", s.coord_client_addr]) == 0
         out = capsys.readouterr().out
         assert '"role": "coordinator"' in out
+    finally:
+        s.close()
+
+
+def test_stats_cli_prom_exposition(capsys):
+    """Acceptance gate (ISSUE 3): --prom emits valid Prometheus text
+    exposition including at least one histogram from each node role of
+    the request path (coordinator, worker, client/powlib)."""
+    s = Stack(1)
+    try:
+        client = s.new_client("client1")
+        mine_and_wait(client, b"\x73\x74", 2)
+        from distpow_tpu.cli.stats import main
+
+        assert main(["--addr", s.coord_client_addr, "--prom"]) == 0
+        out = capsys.readouterr().out
+        assert_valid_prometheus(out)
+        for family in ("distpow_coord_mine_s_miss",      # coordinator
+                       "distpow_worker_solve_s",          # worker
+                       "distpow_powlib_mine_s"):          # client library
+            assert f"# TYPE {family} histogram" in out, family
+    finally:
+        s.close()
+
+
+def test_stats_cli_watch_delta(capsys):
+    s = Stack(1)
+    try:
+        client = s.new_client("client1")
+        mine_and_wait(client, b"\x75\x76", 2)
+        from distpow_tpu.cli.stats import main
+
+        assert main(["--addr", s.coord_client_addr,
+                     "--watch", "0.05", "--count", "2"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("--- coordinator @") == 2
+        # first frame shows absolute counters as deltas from nothing;
+        # the second (quiescent stack) shows no movement
+        assert "coord.mine_rpcs" in out
+        assert "(no counter movement)" in out
+        assert "p50=" in out  # histogram quantiles ride along
     finally:
         s.close()

@@ -1,0 +1,190 @@
+"""Critical-path profiler (scripts/trace_profile.py) — the ISSUE 3
+acceptance gate: the per-Mine-request breakdown over the checked-in
+golden trace must exist and its stage ordering must hold
+(queue <= fanout <= first-result <= cancel-complete), plus the human
+trace-log and flight-recorder-journal input formats parse to the same
+structure."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden_trace.json")
+SCRIPT = os.path.join(REPO, "scripts", "trace_profile.py")
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, SCRIPT, *args],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+
+
+def test_golden_trace_stage_ordering():
+    out = _run(GOLDEN, "--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["ordering_ok"] is True
+    assert payload["violations"] == []
+    assert payload["truncated"] == []
+    requests = payload["requests"]
+    # the demo scenario: four Mine requests, all misses (the dominance
+    # supersede request re-fans out at the higher difficulty)
+    assert len(requests) == 4
+    for req in requests:
+        assert req["path"] == "miss"
+        assert req["queue"] is not None
+        assert (req["queue"] <= req["fanout"] <= req["first_result"]
+                <= req["cancel_complete"] <= req["done"]), req
+        assert req["workers"] >= 1
+        assert req["results"] >= 1
+
+
+def test_golden_trace_human_output_reports_ordering_ok():
+    out = _run(GOLDEN)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "4 Mine request(s)" in out.stdout
+    assert "stage ordering OK" in out.stdout
+    assert "queue <= fanout <= first_result <= cancel_complete" \
+        in out.stdout
+
+
+def test_ordering_violation_fails_both_output_modes(tmp_path):
+    """A trace violating the stage ordering (a miss with no fanout ever
+    recorded) must exit 1 in BOTH the human and --json modes — a CI
+    consumer of the machine-readable output must not silently pass."""
+    bad = tmp_path / "bad_trace.json"
+    bad.write_text(json.dumps({
+        "coordinator": [
+            [5, "CoordinatorMine", "0102", 2],
+            [5, "CacheMiss", "0102", 2],
+            # no CoordinatorWorkerMine: fanout stage missing entirely
+            [5, "CoordinatorWorkerResult", "0102", 2],
+            [5, "CoordinatorSuccess", "0102", 2],
+        ],
+    }))
+    human = _run(str(bad))
+    assert human.returncode == 1, human.stdout + human.stderr
+    assert "ORDERING VIOLATION" in human.stderr
+    machine = _run(str(bad), "--json")
+    assert machine.returncode == 1, machine.stdout + machine.stderr
+    payload = json.loads(machine.stdout)
+    assert payload["ordering_ok"] is False
+    assert payload["violations"] == [5]
+
+
+def test_truncated_round_is_not_an_ordering_violation(tmp_path):
+    """A log captured mid-round (no CoordinatorSuccess — node killed
+    while mining, the crash-forensics case) is reported as truncated,
+    NOT as a protocol ordering violation: exit 0 in both modes."""
+    trunc = tmp_path / "truncated_trace.json"
+    trunc.write_text(json.dumps({
+        "coordinator": [
+            [9, "CoordinatorMine", "0304", 2],
+            [9, "CacheMiss", "0304", 2],
+            [9, "CoordinatorWorkerMine", "0304", 2],
+            # killed here: no result, no cancel, no success
+        ],
+    }))
+    human = _run(str(trunc))
+    assert human.returncode == 0, human.stdout + human.stderr
+    assert "truncated mid-round" in human.stdout
+    machine = _run(str(trunc), "--json")
+    assert machine.returncode == 0, machine.stdout + machine.stderr
+    payload = json.loads(machine.stdout)
+    assert payload["ordering_ok"] is True
+    assert payload["truncated"] == [9]
+
+
+def test_human_trace_log_format_parses(tmp_path):
+    """FileSink/tracing-server lines profile identically to the golden
+    JSON of the same scenario."""
+    log = tmp_path / "trace_output.log"
+    log.write_text(
+        "[client1] TraceID=7 PowlibMiningBegin Nonce=[1, 2], "
+        "NumTrailingZeros=3\n"
+        "[coordinator] TraceID=7 CoordinatorMine Nonce=[1, 2], "
+        "NumTrailingZeros=3\n"
+        "[coordinator] TraceID=7 CacheMiss Nonce=[1, 2], "
+        "NumTrailingZeros=3\n"
+        "[coordinator] TraceID=7 CoordinatorWorkerMine Nonce=[1, 2], "
+        "NumTrailingZeros=3, WorkerByte=0\n"
+        "[coordinator] TraceID=7 CoordinatorWorkerResult Nonce=[1, 2], "
+        "NumTrailingZeros=3, WorkerByte=0, Secret=[9]\n"
+        "[coordinator] TraceID=7 CoordinatorWorkerCancel Nonce=[1, 2], "
+        "NumTrailingZeros=3, WorkerByte=0\n"
+        "[coordinator] TraceID=7 CoordinatorSuccess Nonce=[1, 2], "
+        "NumTrailingZeros=3, Secret=[9]\n"
+    )
+    out = _run(str(log), "--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    (req,) = json.loads(out.stdout)["requests"]
+    assert req["trace_id"] == 7
+    assert req["nonce"] == "0102"
+    assert req["path"] == "miss"
+    assert (req["queue"] < req["fanout"] < req["first_result"]
+            < req["cancel_complete"] < req["done"])
+
+
+def test_flight_recorder_journal_format(tmp_path):
+    """A telemetry JSONL journal (runtime/telemetry.py) yields per-round
+    wall-clock stage timings."""
+    journal = tmp_path / "coordinator.telemetry.jsonl"
+    rid = "00000000deadbeef00000001"
+    events = [
+        {"seq": 1, "ts": 100.0, "kind": "coord.fanout", "round": rid,
+         "nonce": "0102", "ntz": 3},
+        {"seq": 2, "ts": 100.2, "kind": "coord.first_result",
+         "round": rid, "nonce": "0102", "ntz": 3, "worker_byte": 1,
+         "latency_s": 0.2},
+        {"seq": 3, "ts": 100.3, "kind": "coord.cancel_complete",
+         "round": rid, "nonce": "0102", "ntz": 3, "late_results": 1,
+         "latency_s": 0.3},
+        {"seq": 4, "ts": 101.0, "kind": "fault.injected",
+         "kind2": "ignored-non-coord-event"},
+    ]
+    journal.write_text("".join(json.dumps(e) + "\n" for e in events))
+    out = _run(str(journal), "--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    (r,) = json.loads(out.stdout)["rounds"]
+    assert r["round"] == rid
+    assert r["first_result_s"] == 0.2
+    assert r["cancel_propagation_s"] == 0.3
+    assert r["first_result_s"] <= r["cancel_propagation_s"]
+    assert r["late_results"] == 1
+    assert r["winner_byte"] == 1
+
+
+def test_live_stack_trace_profiles_clean(tmp_path):
+    """End-to-end: profile a REAL run's memory-sink trace — not just the
+    checked-in golden — so the profiler tracks the live action
+    vocabulary, not a snapshot of it."""
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_nodes import Stack, mine_and_wait
+    from test_trace_parity import _node_sequence
+
+    s = Stack(2)
+    try:
+        c = s.new_client("client1")
+        mine_and_wait(c, b"\x61\x62", 2)
+        mine_and_wait(c, b"\x61\x62", 2)  # cache hit
+        dump = {ident: _node_sequence(sink)
+                for ident, sink in s.sinks.items()}
+    finally:
+        s.close()
+    trace = tmp_path / "live_trace.json"
+    trace.write_text(json.dumps(dump))
+    out = _run(str(trace), "--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    requests = json.loads(out.stdout)["requests"]
+    assert len(requests) == 2
+    paths = sorted(r["path"] for r in requests)
+    assert paths == ["hit", "miss"]
+    miss = next(r for r in requests if r["path"] == "miss")
+    assert (miss["queue"] <= miss["fanout"] <= miss["first_result"]
+            <= miss["cancel_complete"] <= miss["done"])
+    assert miss["workers"] == 2
