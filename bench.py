@@ -435,12 +435,104 @@ def _device_alive(probe_timeout: int = 180) -> bool:
     return True
 
 
+def serving_stage(ks=(1, 4, 16)) -> dict:
+    """Aggregate serving throughput under concurrency (``--serving``).
+
+    Measures end-to-end solves/s through a REAL in-process stack —
+    coordinator + one worker with the continuous-batching scheduler
+    (docs/SCHEDULER.md) — at K concurrent same-difficulty Mine
+    requests.  The K=1 column is the one-launch-per-request baseline;
+    the batching win is the K=4/K=16 aggregate staying a multiple of
+    it instead of flat.  Fresh nonces per request (no cache hits), so
+    every solve is real device work.  Prints ONE JSON line and returns
+    it; deliberately OUTSIDE the provenance/anomaly machinery — this
+    is a serving-plane number, not a kernel rate.
+    """
+    from distpow_tpu.models import puzzle
+    from distpow_tpu.nodes import Client, Coordinator, Worker
+    from distpow_tpu.runtime.config import (
+        ClientConfig,
+        CoordinatorConfig,
+        WorkerConfig,
+    )
+    from distpow_tpu.runtime.metrics import REGISTRY
+
+    ntz = int(os.environ.get("BENCH_SERVING_NTZ", "4"))
+    batch = int(os.environ.get("BENCH_SERVING_BATCH", str(1 << 14)))
+    coordinator = Coordinator(CoordinatorConfig(
+        ClientAPIListenAddr="127.0.0.1:0",
+        WorkerAPIListenAddr="127.0.0.1:0",
+        Workers=["pending:0"],
+    ))
+    client_addr, worker_api_addr = coordinator.initialize_rpcs()
+    worker = Worker(WorkerConfig(
+        WorkerID="bench-worker",
+        ListenAddr="127.0.0.1:0",
+        CoordAddr=worker_api_addr,
+        Backend="jax",
+        Scheduler="batching",
+        SchedMaxSlots=max(ks),
+        BatchSize=batch,
+        WarmupNonceLens=[],
+        WarmupWidths=[],
+    ))
+    coordinator.set_worker_addrs([worker.initialize_rpcs()])
+    worker.start_forwarder()
+    client = Client(ClientConfig(ClientID="bench", CoordAddr=client_addr))
+    client.initialize()
+    stages: dict = {}
+    try:
+        # one throwaway solve pays the compile before any timed column
+        client.mine(b"\xb0\xff", ntz)
+        assert client.notify_queue.get(timeout=600).error is None
+        for k in ks:
+            occ0 = REGISTRY.get_histogram("sched.batch_occupancy") or \
+                {"count": 0, "sum": 0.0}
+            nonces = [bytes([0xB0, k, i]) for i in range(k)]
+            t0 = time.monotonic()
+            for n in nonces:
+                client.mine(n, ntz)
+            for _ in range(k):
+                res = client.notify_queue.get(timeout=600)
+                assert res.error is None, res.error
+                assert puzzle.check_secret(res.nonce, res.secret, ntz)
+            dt = time.monotonic() - t0
+            occ1 = REGISTRY.get_histogram("sched.batch_occupancy")
+            n_launch = occ1["count"] - occ0["count"]
+            stages[f"k{k}"] = {
+                "solves_per_s": round(k / dt, 3),
+                "wall_s": round(dt, 3),
+                "launches": n_launch,
+                "mean_occupancy": round(
+                    (occ1["sum"] - occ0["sum"]) / max(n_launch, 1), 3),
+            }
+            print(f"[bench] serving k={k}: "
+                  f"{stages[f'k{k}']['solves_per_s']} solves/s "
+                  f"(occupancy {stages[f'k{k}']['mean_occupancy']})",
+                  file=sys.stderr)
+    finally:
+        client.close()
+        worker.shutdown()
+        coordinator.shutdown()
+    line = {
+        "metric": f"serving solves/s, continuous batching, ntz={ntz}",
+        "unit": "solves/s",
+        "value": stages[f"k{max(ks)}"]["solves_per_s"],
+        "stages": stages,
+    }
+    print(json.dumps(line))
+    return line
+
+
 def main() -> None:
     forced = os.environ.get("BENCH_FORCE_PLATFORM")
     if forced:
         import jax
 
         jax.config.update("jax_platforms", forced)
+    if "--serving" in sys.argv:
+        serving_stage()
+        return
     if not _device_alive():
         line = {
             "metric": "MH/s/chip md5 pow search (device unreachable)",

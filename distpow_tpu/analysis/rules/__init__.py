@@ -5,6 +5,7 @@ Catalog with rationale and examples: docs/LINT.md."""
 
 from . import (
     blocking_under_lock,
+    bounded_queue,
     config_key_sync,
     dead_package,
     hot_path_host_sync,
@@ -15,6 +16,7 @@ from . import (
 
 ALL_RULES = (
     blocking_under_lock,
+    bounded_queue,
     trace_vocabulary,
     metrics_registry,
     config_key_sync,

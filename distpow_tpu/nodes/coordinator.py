@@ -68,6 +68,8 @@ from ..runtime.config import CoordinatorConfig
 from ..runtime.rpc import RPCClient, RPCError, RPCServer
 from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
+from ..sched.admission import AdmissionReject
+from ..sched.coalesce import Coalescer
 
 log = logging.getLogger("distpow.coordinator")
 
@@ -200,7 +202,10 @@ class CoordRPCHandler:
                  dial_retry_interval: float = 0.2,
                  cache_file: Optional[str] = None,
                  failure_policy: str = "error",
-                 failure_probe_secs: float = 1.0):
+                 failure_probe_secs: float = 1.0,
+                 sched_max_inflight: int = 0,
+                 sched_retry_after_s: float = 0.5,
+                 sched_coalesce: bool = True):
         self.tracer = tracer
         self.workers = [WorkerRef(a, i) for i, a in enumerate(worker_addrs)]
         # floor(log2(N)) with the reference's uint truncation
@@ -228,6 +233,15 @@ class CoordRPCHandler:
         self._tasks_lock = threading.Lock()
         self._key_locks: Dict[TaskKey, list] = {}
         self._dial_retry_interval = dial_retry_interval
+        # scheduler plane (docs/SCHEDULER.md): in-flight coalescing of
+        # identical keys + bounded-run-queue admission control.  The
+        # admitted count is a reservation counter under _tasks_lock —
+        # counting len(_tasks) instead would let concurrent leaders all
+        # pass the check before any of them registers its task
+        self._coalescer = Coalescer() if sched_coalesce else None
+        self._sched_max_inflight = int(sched_max_inflight or 0)
+        self._sched_retry_after_s = float(sched_retry_after_s)
+        self._sched_inflight = 0
 
     # -- task table (coordinator.go:370-388) -------------------------------
     def _task_set(self, key: TaskKey, rid: str, q: "queue.Queue") -> None:
@@ -374,24 +388,95 @@ class CoordRPCHandler:
             metrics.observe("coord.mine_s.hit", time.monotonic() - t0)
             return self._success_reply(trace, nonce, ntz, cached)
 
-        # serialize concurrent identical requests (documented fix; the
-        # second request re-checks the cache once the first completes)
-        with self._key_lock((nonce, ntz)):
-            cached = self.result_cache.get(nonce, ntz, trace)
-            if cached is not None:
-                # a duplicate that waited out the first request's miss
-                # still counts as a hit: the split is by cache outcome,
-                # not by how long the key lock made it wait
-                metrics.observe("coord.mine_s.hit", time.monotonic() - t0)
-                return self._success_reply(trace, nonce, ntz, cached)
+        key = (nonce, ntz)
+        # attempts bound the waiter->leader promotion loop below; under
+        # normal operation one pass suffices (the loop only re-enters
+        # when a leader vanished without either a result or an error)
+        for _ in range(4):
+            handle = self._coalescer.join(key) if self._coalescer else None
+            if handle is not None and not handle.leader:
+                # in-flight coalescing (sched/coalesce.py): attach to
+                # the live round as a waiter — one fan-out, N replies
+                metrics.inc("sched.coalesced_requests")
+                handle.wait()
+                cached = self.result_cache.get(nonce, ntz, trace)
+                if cached is not None:
+                    # same split rule as the key-lock era: a duplicate
+                    # that waited out the leader's round is a hit
+                    metrics.observe("coord.mine_s.hit",
+                                    time.monotonic() - t0)
+                    return self._success_reply(trace, nonce, ntz, cached)
+                err = handle.error()
+                if err is not None:
+                    # the leader's typed failure applies to the whole
+                    # round — fresh instances, so concurrent waiters
+                    # never share one exception's traceback
+                    if isinstance(err, AdmissionReject):
+                        raise AdmissionReject(
+                            err.retry_after_s, "coalesced round rejected"
+                        )
+                    raise RuntimeError(f"coalesced mine failed: {err}")
+                continue  # leader vanished resultless: try leading
+            err2: Optional[BaseException] = None
             try:
-                return self._mine_miss(trace, nonce, ntz)
+                # serialize concurrent identical requests (documented
+                # fix; with coalescing on, only round leaders ever
+                # contend here)
+                with self._key_lock(key):
+                    cached = self.result_cache.get(nonce, ntz, trace)
+                    if cached is not None:
+                        metrics.observe("coord.mine_s.hit",
+                                        time.monotonic() - t0)
+                        return self._success_reply(trace, nonce, ntz, cached)
+                    reserved = self._admit(nonce, ntz)
+                    try:
+                        return self._mine_miss(trace, nonce, ntz)
+                    finally:
+                        if reserved:
+                            with self._tasks_lock:
+                                self._sched_inflight -= 1
+                        # errors included (the rpc.py dispatch-timing
+                        # discipline): an all-workers-died RuntimeError
+                        # after minutes of reassign probing is exactly
+                        # the outage latency this split exists to show
+                        metrics.observe("coord.mine_s.miss",
+                                        time.monotonic() - t0)
+            except BaseException as exc:
+                err2 = exc
+                raise
             finally:
-                # errors included (the rpc.py dispatch-timing
-                # discipline): an all-workers-died RuntimeError after
-                # minutes of reassign probing is exactly the outage
-                # latency this split exists to show
-                metrics.observe("coord.mine_s.miss", time.monotonic() - t0)
+                if handle is not None:
+                    # every leader exit path releases the waiters —
+                    # success or failure — or they would park forever
+                    handle.finish(error=err2)
+        raise RuntimeError(
+            f"mine for {nonce.hex()}/{ntz} made no progress after "
+            f"repeated coalesced rounds"
+        )
+
+    def _admit(self, nonce: bytes, ntz: int) -> bool:
+        """Bounded run queue (docs/SCHEDULER.md): shed the request with
+        a typed RETRY_AFTER once the admitted-round count hits the
+        configured bound, instead of queueing without limit.  Check and
+        reservation are ONE critical section, so concurrent leaders
+        cannot all pass at limit-1; returns True when the caller holds
+        a reservation it must release when its round ends."""
+        limit = self._sched_max_inflight
+        if not limit:
+            return False
+        with self._tasks_lock:
+            inflight = self._sched_inflight
+            if inflight < limit:
+                self._sched_inflight = inflight + 1
+                return True
+        metrics.inc("sched.admission_rejected")
+        RECORDER.record("sched.admission_reject", nonce=nonce.hex(),
+                        ntz=ntz, inflight=inflight, limit=limit,
+                        retry_after_s=self._sched_retry_after_s)
+        raise AdmissionReject(
+            self._sched_retry_after_s,
+            f"coordinator run queue full ({inflight}/{limit})",
+        )
 
     def _send_mine(self, trace, nonce: bytes, ntz: int, w: WorkerRef,
                    worker_byte: int, rid: str) -> bool:
@@ -452,6 +537,12 @@ class CoordRPCHandler:
     def _mine_miss(self, trace, nonce: bytes, ntz: int) -> dict:
         self._initialize_workers()
         key = (nonce, ntz)
+        # distpow: ok bounded-queue -- protocol-bounded: one round's
+        # queue holds at most 2 messages per live worker (the 2N-ack
+        # ledger) plus one ack per re-broadcast, and the Result handler
+        # drops stale-round messages before they are enqueued; a hard
+        # maxsize that ever blocked the Result dispatch thread would
+        # wedge the whole round instead
         results: "queue.Queue" = queue.Queue()
         rid = new_round_id(self.restart_epoch)
         self._task_set(key, rid, results)
@@ -698,6 +789,10 @@ class CoordRPCHandler:
         snap["active_tasks"] = len(self._tasks)
         snap["cache_entries"] = len(self.result_cache)
         snap["failure_policy"] = self.failure_policy
+        snap["sched"] = {
+            "max_inflight": self._sched_max_inflight,
+            "coalesce": self._coalescer is not None,
+        }
         return snap
 
 
@@ -726,6 +821,9 @@ class Coordinator:
             cache_file=getattr(config, "CacheFile", "") or None,
             failure_policy=getattr(config, "FailurePolicy", "error") or "error",
             failure_probe_secs=getattr(config, "FailureProbeSecs", 1.0),
+            sched_max_inflight=getattr(config, "SchedMaxInflight", 0),
+            sched_retry_after_s=getattr(config, "SchedRetryAfterS", 0.5),
+            sched_coalesce=getattr(config, "SchedCoalesce", True),
         )
         self.server = RPCServer()
         self.server.register("CoordRPCHandler", self.handler)

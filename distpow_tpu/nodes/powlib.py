@@ -45,6 +45,16 @@ Documented divergences from the reference:
   retried — re-issuing would just re-earn it — and surfaces as an
   error result immediately.  Counters: ``powlib.retries``,
   ``powlib.reconnects``, ``powlib.degraded`` (runtime/metrics.py).
+* **Server-paced backpressure is retried without burning budget.**
+  A typed RETRY_AFTER rejection (``rpc.RPCRetryAfter``, minted by the
+  coordinator's admission control — sched/admission.py) waits the
+  server's own hint and re-issues as a NON-COUNTING attempt: load
+  shedding is the server working as designed, so it never consumes the
+  transport retry budget nor interacts with the reconnect machinery
+  (the connection is healthy).  Only the overall attempts ceiling
+  bounds it, so a permanently saturated coordinator still terminates
+  in a ``degraded:`` error instead of a hang.  Counter:
+  ``powlib.retry_after``.
 * **Close handshake.**  The reference re-sends the close token so
   ``Close()`` rendezvouses with every in-flight goroutine
   (powlib.go:179-182) — a mechanism its tracing library needs to keep
@@ -69,7 +79,7 @@ from typing import Optional
 
 from ..runtime import actions as act
 from ..runtime.metrics import REGISTRY as metrics
-from ..runtime.rpc import RPCClient, RPCError, RPCTransportError
+from ..runtime.rpc import RPCClient, RPCError, RPCRetryAfter, RPCTransportError
 from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, encode_token
 
@@ -79,6 +89,11 @@ log = logging.getLogger("distpow.powlib")
 DEFAULT_RETRIES = 4
 DEFAULT_BACKOFF_S = 0.2
 DEFAULT_BACKOFF_MAX_S = 2.0
+# Bounds on the server's RETRY_AFTER hint (sched/admission.py): the
+# floor keeps a zero/garbage hint from spinning; the cap keeps a
+# misconfigured server from parking a mine for minutes per attempt.
+RETRY_AFTER_MIN_S = 0.01
+RETRY_AFTER_MAX_S = 30.0
 
 
 def backoff_delay(attempt: int, base: float, cap: float,
@@ -304,6 +319,33 @@ class POW:
                 )
                 if self._reconnect(gen, attempt - 1):
                     budget = self.retries
+            except RPCRetryAfter as exc:
+                # server-paced backpressure (the coordinator's bounded
+                # run queue, sched/admission.py): wait exactly as long
+                # as the server asked and re-issue.  NON-COUNTING: the
+                # transport-failure budget stays untouched — shedding
+                # load is the server working as designed, not an
+                # outage, so it must never walk a client toward the
+                # terminal "degraded:" error.  The overall attempts
+                # ceiling still applies, keeping the never-hangs
+                # contract true against a permanently saturated server.
+                attempt += 1
+                if attempt >= attempts_cap:
+                    metrics.inc("powlib.degraded")
+                    RECORDER.record("powlib.degraded", nonce=nonce.hex(),
+                                    ntz=ntz, attempts=attempt,
+                                    error=str(exc))
+                    raise _MineFailed(
+                        f"degraded: mine RPC backpressured after "
+                        f"{attempt} attempt(s): {exc}"
+                    )
+                metrics.inc("powlib.retry_after")
+                delay = min(max(exc.delay_s, RETRY_AFTER_MIN_S),
+                            RETRY_AFTER_MAX_S)
+                log.info("mine backpressured (%s); retrying in %.3fs "
+                         "(server-paced, budget untouched)", exc, delay)
+                if self._close_ev.wait(delay):
+                    return None
             except RPCError as exc:
                 # the coordinator's handler returned an error: re-issuing
                 # would re-earn it — surface immediately (module docstring)

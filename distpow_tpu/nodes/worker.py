@@ -130,10 +130,16 @@ class WorkerRPCHandler:
     """RPC service ``WorkerRPCHandler`` (Mine / Found / Cancel)."""
 
     def __init__(self, tracer: Tracer, result_queue: "queue.Queue", backend,
-                 cache_file: Optional[str] = None):
+                 cache_file: Optional[str] = None, scheduler=None):
         self.tracer = tracer
         self.result_queue = result_queue
         self.backend = backend
+        # continuous-batching scheduler (sched/engine.py): when set,
+        # miner threads submit slots to its shared device loop instead
+        # of each owning backend.search — worker.active_searches then
+        # stays at the loop's own concurrency (bounded), and the
+        # pile-up signal moves to sched.active_slots/run_queue_depth
+        self.scheduler = scheduler
         self.result_cache = ResultCache(persist_path=cache_file or None)
         self._tasks: Dict[TaskKey, TaskRound] = {}
         self._tasks_lock = threading.Lock()
@@ -272,6 +278,7 @@ class WorkerRPCHandler:
         snap = metrics.snapshot()
         snap["role"] = "worker"
         snap["backend"] = type(self.backend).__name__
+        snap["scheduler"] = "batching" if self.scheduler is not None else "off"
         snap["active_tasks"] = len(self._tasks)
         snap["cache_entries"] = len(self.result_cache)
         snap["watchdog_armed"] = WATCHDOG.running
@@ -337,13 +344,22 @@ class WorkerRPCHandler:
                     or self.result_cache.satisfies(nonce, ntz) is not None)
 
         tbs = partition.thread_bytes(worker_byte, worker_bits)
-        self._searches_delta(+1)
-        try:
-            secret = self.backend.search(
+        if self.scheduler is not None:
+            # scheduler path: this thread only parks on the slot's
+            # completion — the engine's single loop owns the device, so
+            # the active_searches pile-up the contention stress test
+            # recorded cannot form (docs/SCHEDULER.md)
+            secret = self.scheduler.search(
                 nonce, ntz, tbs, cancel_check=cancel_check
             )
-        finally:
-            self._searches_delta(-1)
+        else:
+            self._searches_delta(+1)
+            try:
+                secret = self.backend.search(
+                    nonce, ntz, tbs, cancel_check=cancel_check
+                )
+            finally:
+                self._searches_delta(-1)
         if round_.superseded:
             # a newer Mine owns this key now; anything we emit would be
             # mis-attributed to its round (see TaskRound) — exit silently
@@ -412,6 +428,11 @@ class Worker:
             sink=sink,
         )
         self.coordinator = RPCClient(config.CoordAddr)
+        # distpow: ok bounded-queue -- the forwarder queue must never
+        # drop or block the miner: every message is owed to the
+        # coordinator's ack ledger (losing one wedges the round), depth
+        # is bounded by in-flight rounds x2 in practice, and the
+        # backlog is observable (worker.forward_queue_depth gauge)
         self.result_queue: "queue.Queue" = queue.Queue()
         backend = get_backend(
             config.Backend,
@@ -421,9 +442,23 @@ class Worker:
             max_launch=config.MaxLaunchCandidates or None,
             interpret=getattr(config, "PallasInterpret", False),
         )
+        self.scheduler = None
+        if (getattr(config, "Scheduler", "off") or "off") == "batching":
+            # continuous-batching serving plane (docs/SCHEDULER.md):
+            # the engine owns the device; the configured backend stays
+            # as the fallback for shapes the packed step can't express
+            from ..sched.engine import BatchingScheduler
+
+            self.scheduler = BatchingScheduler(
+                hash_model=config.HashModel,
+                batch_size=config.BatchSize,
+                max_slots=getattr(config, "SchedMaxSlots", 8) or 8,
+                fallback=backend,
+            )
         self.handler = WorkerRPCHandler(
             self.tracer, self.result_queue, backend,
             cache_file=getattr(config, "CacheFile", "") or None,
+            scheduler=self.scheduler,
         )
         self.server = RPCServer()
         self.server.register("WorkerRPCHandler", self.handler)
@@ -539,6 +574,10 @@ class Worker:
     def shutdown(self) -> None:
         try:
             self._stopping.set()
+            if self.scheduler is not None:
+                # first: parked miner threads unblock (their slots
+                # finish as cancelled) before the forwarder drains
+                self.scheduler.close()
             self.result_queue.put(None)
             self.server.shutdown()
             self.coordinator.close()

@@ -360,3 +360,67 @@ def flat_to_candidate(
 ) -> Tuple[int, int]:
     """Host-side inverse of the step's index map: flat -> (chunk, tb)."""
     return chunk0 + f // tb_count, tb_lo + f % tb_count
+
+
+@functools.lru_cache(maxsize=None)
+def slot_search_step(
+    model_name: str,
+    n_blocks: int,
+    tb_loc,
+    chunk_locs,
+    batch: int,
+    n_slots: int,
+    launch_steps: int = 1,
+):
+    """Multi-slot serving step: ``n_slots`` independent searches in ONE
+    dispatch (the continuous-batching scheduler's hot op, sched/engine.py).
+
+    The single-slot dynamic regime (``_dyn_search_step``) already makes
+    every per-request quantity a runtime operand; this step vmaps that
+    lane over a leading slot axis, so one compiled program evaluates a
+    whole *batch of searches* — each slot with its own nonce operands,
+    its own difficulty masks, and its own partition — and returns the
+    per-slot first-hit flat index (or SENTINEL) as a ``uint32[n_slots]``
+    vector fetched in a single host<->device round trip.
+
+    Signature of the returned jitted fn (all uint32):
+    ``(init[n, S], base[n, n_blocks, W], masks[n, D], tb_lo[n],
+    log_tbc[n], chunk0[n]) -> uint32[n]``.
+
+    Differences from the single-slot step, both deliberate:
+
+    * masks carry ALL digest words (``mask_words`` is not a compile
+      key): per-slot difficulty is then purely an operand, so slots at
+      different difficulties share one program — the whole point of
+      packing them.
+    * the partition rides ``log_tbc`` per slot (power-of-two partitions
+      only; the scheduler falls back to solo search otherwise), so one
+      lane's flat range ``[0, batch)`` spans ``batch >> log_tbc`` chunk
+      values — lanes with narrower partitions simply cover more chunks
+      per launch.
+    """
+    model = get_hash_model(model_name)
+    _check_launch(batch, launch_steps)
+
+    def one(init, base, masks, tb_lo, log_tbc, chunk0):
+        def sub(f):
+            chunk = jnp.uint32(chunk0) + (f >> log_tbc)
+            tb = tb_lo + (f & ((jnp.uint32(1) << log_tbc) - jnp.uint32(1)))
+            state = eval_dyn_candidates(
+                model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
+            )
+            hit = fold_dyn_masks(model, state, masks)
+            return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
+
+        f0 = jnp.arange(batch, dtype=jnp.uint32)
+        if launch_steps == 1:
+            return sub(f0)
+
+        def body(i, best):
+            return jnp.minimum(
+                best, sub(i.astype(jnp.uint32) * jnp.uint32(batch) + f0)
+            )
+
+        return jax.lax.fori_loop(0, launch_steps, body, jnp.uint32(SENTINEL))
+
+    return jax.jit(jax.vmap(one))

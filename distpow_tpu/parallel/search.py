@@ -185,6 +185,27 @@ _RATE_METER = _RateMeter()
 from .partition import contiguous_bounds  # noqa: E402,F401
 
 
+def assemble_secret(
+    chunk0: int, f: int, vw: int, extra: bytes, tb_lo: int, tbc: int
+) -> Tuple[bytes, int]:
+    """Host-side inverse of a launch's flat index: ``(secret, tb)``.
+
+    One home for the candidate reconstruction both drivers share — the
+    solo FIFO drain below and the continuous-batching scheduler's
+    per-slot drain (sched/engine.py).  The width mask reproduces the
+    launch-overrun aliasing documented in the module docstring: an
+    overshot chunk int wraps into a zero-top-byte encoding, which is a
+    valid (verified) secret even though it is off the canonical
+    enumeration.
+    """
+    chunk_int = (chunk0 + f // tbc) & 0xFFFFFFFF
+    tb = tb_lo + f % tbc
+    chunk_bytes = (
+        (chunk_int & (256 ** vw - 1)).to_bytes(vw, "little") if vw else b""
+    ) + extra
+    return bytes([tb]) + chunk_bytes, tb
+
+
 def width_segments(width: int):
     """Yield (variable_width, chunk_lo, chunk_hi, extra_const_chunk) for one
     chunk width.  For width <= 4 the whole width is one dense uint32 range;
@@ -298,12 +319,8 @@ def search(
         _RATE_METER.note(n_cand)
         if f == SENTINEL:
             return None
-        chunk_int = (chunk0 + f // tbc) & 0xFFFFFFFF
-        tb = tb_lo + f % tbc
-        chunk_bytes = (
-            (chunk_int & (256 ** vw - 1)).to_bytes(vw, "little") if vw else b""
-        ) + extra
-        secret = bytes([tb]) + chunk_bytes
+        secret, tb = assemble_secret(chunk0, f, vw, extra, tb_lo, tbc)
+        chunk_bytes = secret[1:]
         if not puzzle.check_secret(nonce, secret, difficulty, model.name):
             raise RuntimeError(
                 f"kernel returned non-solving candidate tb={tb} "

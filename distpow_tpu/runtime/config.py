@@ -105,6 +105,20 @@ class CoordinatorConfig:
     # dump-on-fault snapshots land here.  Empty = memory-only ring.
     # Also reachable via $DISTPOW_TELEMETRY_DIR.
     TelemetryDir: str = ""
+    # --- scheduler plane (distpow_tpu/sched/, docs/SCHEDULER.md) ---------
+    # Admission control: maximum concurrently fanned-out miss rounds.
+    # A Mine arriving beyond the bound is rejected with a typed
+    # RETRY_AFTER reply (sched/admission.py) that powlib's backoff
+    # machinery consumes as a server-paced, non-counting retry.
+    # 0 = unbounded (reference-parity default).
+    SchedMaxInflight: int = 0
+    # Retry-after hint (seconds) carried by admission rejections.
+    SchedRetryAfterS: float = 0.5
+    # In-flight coalescing of identical (nonce, ntz) Mine requests into
+    # one fan-out round with a multi-waiter reply (sched/coalesce.py).
+    # On by default: it is a scheduling upgrade of the documented
+    # per-key-mutex duplicate fix with identical trace shapes.
+    SchedCoalesce: bool = True
 
 
 @dataclass
@@ -173,6 +187,18 @@ class WorkerConfig:
     # dump-on-fault snapshots land here.  Empty = memory-only ring.
     # Also reachable via $DISTPOW_TELEMETRY_DIR.
     TelemetryDir: str = ""
+    # --- scheduler plane (distpow_tpu/sched/, docs/SCHEDULER.md) ---------
+    # "batching" multiplexes concurrent Mine searches onto shared
+    # batched device launches through the continuous-batching engine
+    # (sched/engine.py slot table over the ops/search_step.py batch
+    # axis); "off" keeps one-launch-per-request reference behavior.
+    # Searches the packed step cannot express (non-power-of-two
+    # partitions, unsatisfiable difficulties) fall back to Backend.
+    Scheduler: str = "off"
+    # Slot-table width: maximum searches packed into one device launch
+    # (also the preemption bound — requests beyond it wait in the run
+    # queue under deterministic weighted-fair rotation).
+    SchedMaxSlots: int = 8
 
 
 @dataclass

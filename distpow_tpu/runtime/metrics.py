@@ -29,6 +29,20 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
 * ``cache.hit`` / ``cache.miss`` / ``cache.add`` / ``cache.evict``
 * ``powlib.retries`` / ``powlib.reconnects`` / ``powlib.degraded``
   — client-side coordinator-outage recovery (nodes/powlib.py)
+* ``powlib.retry_after`` — server-paced RETRY_AFTER backpressure
+  retries (non-counting: they never burn the transport retry budget)
+* ``sched.launches`` — batched device dispatches issued by the
+  continuous-batching engine (sched/engine.py)
+* ``sched.admission_rejected`` — Mine requests shed by the
+  coordinator's bounded run queue (sched/admission.py)
+* ``sched.coalesced_requests`` — duplicate in-flight Mines attached as
+  waiters to an existing fan-out round (sched/coalesce.py)
+* ``sched.slots_preempted`` — active slots rotated back to the run
+  queue by the weighted-fair allocator under oversubscription
+* ``sched.fallback_searches`` — searches the packed step could not
+  express, served through the wrapped solo backend
+* ``sched.loop_failures`` — scheduler device-loop deaths (slots fail
+  over to errors, never hangs)
 * ``rpc.handler_errors`` — handler exceptions returned to callers in
   the response frame (runtime/rpc.py _dispatch)
 * ``compile_cache.errors`` (+ ``.read_errors`` / ``.write_errors`` /
@@ -51,13 +65,18 @@ Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 * ``search.launch_s``  — time blocked fetching one launch's result
   (the driver's FIFO drain; parallel/search.py)
 * ``powlib.mine_s``    — client-observed mine round-trip incl. retries
+* ``sched.batch_occupancy`` — real (non-padding) slots per batched
+  launch: the continuous-batching win is this distribution's mean
+* ``sched.slot_wait_s`` — submit-to-first-dispatch queueing latency of
+  a scheduler slot (admission + run-queue wait)
 * ``rpc.frame.sent_bytes`` / ``rpc.frame.recv_bytes`` — wire frame sizes
 * ``rpc.client.call_s.<Service.Method>``     — per-method round-trip
 * ``rpc.server.dispatch_s.<Service.Method>`` — per-method handler time
 
 Gauges (not lint-gated — gauges are set, never minted by typo'd
 increments): ``worker.active_searches``, ``worker.mine_queue_depth``,
-``worker.forward_queue_depth``, ``search.hashes_per_s``.
+``worker.forward_queue_depth``, ``search.hashes_per_s``,
+``sched.active_slots``, ``sched.run_queue_depth``.
 """
 
 from __future__ import annotations
@@ -84,6 +103,10 @@ KNOWN_COUNTERS = frozenset({
     "coord.stale_results_dropped",
     "cache.hit", "cache.miss", "cache.add", "cache.evict",
     "powlib.retries", "powlib.reconnects", "powlib.degraded",
+    "powlib.retry_after",
+    "sched.launches", "sched.admission_rejected",
+    "sched.coalesced_requests", "sched.slots_preempted",
+    "sched.fallback_searches", "sched.loop_failures",
     "rpc.handler_errors",
     "compile_cache.errors", "compile_cache.read_errors",
     "compile_cache.write_errors", "compile_cache.keygen_errors",
@@ -105,6 +128,7 @@ KNOWN_HISTOGRAMS = frozenset({
     "worker.solve_s", "worker.time_to_cancel_s",
     "search.launch_s",
     "powlib.mine_s",
+    "sched.batch_occupancy", "sched.slot_wait_s",
     "rpc.frame.sent_bytes", "rpc.frame.recv_bytes",
 })
 

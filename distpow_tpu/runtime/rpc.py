@@ -48,6 +48,21 @@ class RPCTransportError(RPCError):
     correct, while a handler error would just be re-earned."""
 
 
+class RPCRetryAfter(RPCError):
+    """The remote handler REJECTED the call with server-paced
+    backpressure (the scheduler's admission control,
+    sched/admission.py): the ``retry_after`` field of the response
+    frame says when to try again.  A third retry class beside the two
+    above: unlike a handler error it IS worth re-issuing — the server
+    itself asked for the retry — and unlike a transport failure the
+    retry is paced by the server's hint and must not burn the client's
+    transport-failure budget (nodes/powlib.py)."""
+
+    def __init__(self, message: str, delay_s: float):
+        super().__init__(message)
+        self.delay_s = float(delay_s)
+
+
 def _read_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
     while len(buf) < n:
@@ -225,6 +240,16 @@ class RPCServer:
         except Exception as exc:  # handler errors travel to the caller
             metrics.inc("rpc.handler_errors")
             resp = {"id": rid, "result": None, "error": f"{type(exc).__name__}: {exc}"}
+            # typed backpressure: an exception carrying retry_after_s
+            # (duck-typed — the runtime layer must not import sched)
+            # ships the hint as a dedicated frame field so clients get
+            # a machine-readable RETRY_AFTER, not a string to parse
+            retry_after = getattr(exc, "retry_after_s", None)
+            if retry_after is not None:
+                try:
+                    resp["retry_after"] = float(retry_after)
+                except (TypeError, ValueError):
+                    pass
         if faults.PLAN is not None:
             hit = faults.PLAN.on_frame(
                 "server", str(req.get("method") or ""), peer
@@ -354,7 +379,20 @@ class RPCClient:
                 if fut is None:
                     continue
                 if resp.get("error"):
-                    fut.set_exception(RPCError(resp["error"]))
+                    # a malformed hint must NOT kill the reader thread
+                    # (a TypeError here would skip the fail-all
+                    # teardown below and strand every pending future):
+                    # degrade to a plain RPCError instead
+                    try:
+                        retry_after = float(resp["retry_after"])
+                    except (KeyError, TypeError, ValueError):
+                        retry_after = None
+                    if retry_after is not None:
+                        fut.set_exception(RPCRetryAfter(
+                            resp["error"], retry_after
+                        ))
+                    else:
+                        fut.set_exception(RPCError(resp["error"]))
                 else:
                     fut.set_result(resp.get("result"))
         except (ConnectionError, OSError, ValueError, RPCError) as exc:

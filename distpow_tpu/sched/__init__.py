@@ -1,0 +1,34 @@
+"""Continuous-batching search scheduler (docs/SCHEDULER.md).
+
+The serving plane between the RPC protocol and the device:
+
+* :mod:`engine`    — worker-side continuous-batching engine: a slot
+  table multiplexing many concurrent puzzle searches onto shared
+  batched device launches (ops/search_step.py ``slot_search_step``),
+  with deterministic weighted-fair slot allocation and join/leave at
+  launch boundaries.
+* :mod:`admission` — typed backpressure: the bounded-run-queue
+  rejection (``AdmissionReject``) whose ``retry_after_s`` hint rides
+  the RPC error frame to powlib's backoff machinery as a non-counting,
+  server-paced retry.
+* :mod:`coalesce`  — coordinator-side in-flight request coalescing:
+  identical ``(nonce, ntz)`` Mines share one fan-out round with a
+  multi-waiter reply.
+"""
+
+from .admission import AdmissionReject
+from .coalesce import Coalescer
+
+__all__ = ["AdmissionReject", "BatchingScheduler", "Coalescer"]
+
+
+def __getattr__(name):
+    # admission + coalesce are stdlib-only and safe for the DEVICE-LESS
+    # coordinator/client processes; the engine transitively imports jax
+    # (ops/search_step.py), so it loads lazily — only a worker that
+    # actually configures Scheduler="batching" pays the import
+    if name == "BatchingScheduler":
+        from .engine import BatchingScheduler
+
+        return BatchingScheduler
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

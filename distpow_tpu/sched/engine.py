@@ -1,0 +1,434 @@
+"""Continuous-batching engine — many searches, one device loop.
+
+Before this subsystem, each worker Mine owned the device: K concurrent
+requests piled up K miner threads that serialized through
+``parallel/search.py`` (the PR-3 contention stress test recorded the
+pile-up in ``worker.active_searches``), wasting the batch dimension the
+device sweeps anyway.  This engine inverts the ownership: ONE device
+loop thread holds the accelerator, and requests become *slots* in a
+table the loop packs into shared batched launches via
+``ops/search_step.py slot_search_step`` — the same continuous-batching
+insight that powers modern inference servers, applied to puzzle search.
+
+Slot lifecycle (docs/SCHEDULER.md):
+
+* **join** — ``submit()`` appends a slot to the run queue; the loop
+  admits it at the next launch boundary.  A new Mine never waits for
+  another request's search to *finish* — only for the in-flight launch
+  (the same one-launch granularity solo cancellation already had).
+* **run** — each iteration the loop picks the most-starved slot
+  (minimum virtual time; deterministic ``(vtime, seq)`` order), packs
+  every compatible active slot into one vmapped dispatch, and fetches
+  the per-slot first-hit vector in a single host sync.  Per-slot
+  difficulty masks and partitions are runtime operands, so slots at
+  different difficulties share one compiled program.
+* **leave** — a hit (host-verified), a cancel (polled per boundary), or
+  an exhausted enumeration finishes the slot and wakes its waiter.
+
+Weighted-fair allocation: a slot's virtual time advances by
+``candidates / weight`` per launch, and both launch selection and
+oversubscription preemption order by ``(vtime, seq)`` — a hard
+(high-ntz) puzzle therefore gets exactly its fair share of launches and
+can never starve cheap ones, while cheap ones finish within a bounded
+number of quanta.  When the slot table is full, the loop preempts the
+most-served active slot back to the run queue once it is a full quantum
+ahead of the queue head (flight-recorder event ``sched.slot_preempt``).
+
+Searches the packed step cannot express — non-power-of-two partitions,
+unsatisfiable difficulties — fall back to the wrapped solo backend, so
+the engine is always a drop-in for ``backend.search``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..models import puzzle
+from ..models.registry import get_hash_model
+from ..ops.difficulty import nibble_masks
+from ..ops.packing import build_tail_spec
+from ..ops.search_step import SENTINEL, slot_search_step
+from ..parallel.partition import contiguous_bounds
+from ..parallel.search import assemble_secret, effective_batch, width_segments
+from ..runtime.metrics import REGISTRY as metrics
+from ..runtime.telemetry import RECORDER
+from ..runtime.watchdog import FIRST_COMPILE_GRACE_S, WATCHDOG
+
+log = logging.getLogger("distpow.sched")
+
+# Idle/reap poll period: how often queued-slot cancels are honored when
+# the device is otherwise quiet (active slots are reaped every launch
+# boundary, which is far more frequent under load).
+_IDLE_TICK_S = 0.02
+
+
+class Slot:
+    """One active search's scheduler state.  ``done`` fires exactly once
+    with either ``secret`` set (hit), ``secret=None`` (cancelled or
+    enumeration exhausted), or ``error`` set (engine failure)."""
+
+    __slots__ = (
+        "seq", "nonce", "ntz", "tb_lo", "tbc", "log_tbc", "weight",
+        "cancel_check", "masks", "done", "secret", "error", "vtime",
+        "launches", "submitted_t", "first_launch_t", "exhausted",
+        "_segments", "vw", "seg_hi", "extra", "spec", "chunk0",
+        "_cancelled",
+    )
+
+    def __init__(self, seq: int, nonce: bytes, ntz: int, tb_lo: int,
+                 tbc: int, cancel_check, weight: float, masks, segments):
+        self.seq = seq
+        self.nonce = nonce
+        self.ntz = ntz
+        self.tb_lo = tb_lo
+        self.tbc = tbc
+        self.log_tbc = tbc.bit_length() - 1
+        self.weight = weight
+        self.cancel_check = cancel_check
+        self.masks = masks
+        self.done = threading.Event()
+        self.secret: Optional[bytes] = None
+        self.error: Optional[str] = None
+        self.vtime = 0.0
+        self.launches = 0
+        self.submitted_t = time.monotonic()
+        self.first_launch_t: Optional[float] = None
+        self.exhausted = False
+        self._segments = segments
+        self._cancelled = False
+        self.vw = 0
+        self.seg_hi = 0
+        self.extra = b""
+        self.spec = None
+        self.chunk0 = 0
+
+    def cancel(self) -> None:
+        """Request cancellation; honored at the next launch boundary."""
+        self._cancelled = True
+
+    def cancel_requested(self) -> bool:
+        if self._cancelled:
+            return True
+        if self.cancel_check is not None and self.cancel_check():
+            self._cancelled = True
+        return self._cancelled
+
+    def result(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        """Block for the slot's outcome; raises on engine failure."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"slot {self.seq} not done in {timeout}s")
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return self.secret
+
+
+class BatchingScheduler:
+    """Drop-in for ``backend.search`` that multiplexes concurrent
+    searches onto shared batched launches (module docstring).
+
+    ``fallback`` is the wrapped solo backend for shapes the packed step
+    cannot express.  ``start=False`` defers the device loop (tests
+    submit a deterministic slot set first, then :meth:`start`).
+    """
+
+    def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20,
+                 max_slots: int = 8, max_width: int = 8, fallback=None,
+                 start: bool = True):
+        self.model = get_hash_model(hash_model)
+        self.batch = effective_batch(batch_size)
+        self.max_slots = max(1, int(max_slots))
+        self.max_width = max_width
+        self.fallback = fallback
+        self._cond = threading.Condition()
+        self._pending: List[Slot] = []
+        self._active: List[Slot] = []
+        self._seq = 0
+        self._stop = threading.Event()
+        self._dead = False
+        self._compiled: set = set()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="sched-batching-loop", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop the loop; unfinished slots complete with ``None`` (the
+        cancelled shape — callers see a clean no-result, not a hang)."""
+        self._stop.set()
+        with self._cond:
+            # reject submissions racing with shutdown BEFORE draining:
+            # a slot appended after the drain would have no loop left
+            # to ever finish it (search() routes the refusal to the
+            # fallback backend)
+            self._dead = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        with self._cond:
+            leftovers = self._pending + self._active
+            self._pending = []
+            self._active = []
+            self._publish_gauges_locked()
+        for s in leftovers:
+            self._finish(s, None)
+
+    # -- submission ---------------------------------------------------------
+    def supports(self, difficulty: int, thread_bytes: Sequence[int]) -> bool:
+        """True when the packed step can serve this shape: a contiguous
+        power-of-two partition and a satisfiable difficulty."""
+        try:
+            _, tbc = contiguous_bounds(thread_bytes)
+        except ValueError:
+            return False
+        return (0 < tbc <= 256 and tbc & (tbc - 1) == 0
+                and difficulty <= self.model.max_difficulty)
+
+    def submit(self, nonce: bytes, difficulty: int,
+               thread_bytes: Sequence[int],
+               cancel_check: Optional[Callable[[], bool]] = None,
+               weight: float = 1.0) -> Slot:
+        nonce = bytes(nonce)
+        tb_lo, tbc = contiguous_bounds(thread_bytes)
+        masks = nibble_masks(difficulty, self.model)
+        segments = self._segment_stream()
+        with self._cond:
+            if self._dead:
+                raise RuntimeError(
+                    "batching scheduler is closed or its device loop died"
+                )
+            self._seq += 1
+            slot = Slot(self._seq, nonce, difficulty, tb_lo, tbc,
+                        cancel_check, weight, masks, segments)
+            # virtual-clock floor: a joining slot starts at the
+            # currently most-starved slot's vtime, not 0 — otherwise a
+            # stream of fresh arrivals (each sorting first at vtime 0)
+            # would outrank a long-running slot forever and starve it,
+            # the exact failure the fair clock exists to prevent
+            slot.vtime = min(
+                (s.vtime for s in self._active + self._pending),
+                default=0.0,
+            )
+            if not self._advance_segment(slot):
+                raise RuntimeError("empty enumeration")  # unreachable
+            self._pending.append(slot)
+            self._publish_gauges_locked()
+            self._cond.notify_all()
+        return slot
+
+    def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
+        """Backend-compatible facade: first solving secret or None."""
+        if self._dead or not self.supports(difficulty, thread_bytes):
+            if self.fallback is None:
+                raise ValueError(
+                    f"unsupported search shape for the batching scheduler "
+                    f"(difficulty={difficulty}) and no fallback backend"
+                )
+            metrics.inc("sched.fallback_searches")
+            return self.fallback.search(
+                nonce, difficulty, thread_bytes, cancel_check=cancel_check
+            )
+        try:
+            slot = self.submit(nonce, difficulty, thread_bytes,
+                               cancel_check=cancel_check)
+        except RuntimeError:
+            # closed/died between the liveness check and the append —
+            # the slot was never queued, so serve solo rather than
+            # hang or leak the race to the miner thread
+            if self.fallback is None:
+                raise
+            metrics.inc("sched.fallback_searches")
+            return self.fallback.search(
+                nonce, difficulty, thread_bytes, cancel_check=cancel_check
+            )
+        return slot.result()
+
+    # -- cursor -------------------------------------------------------------
+    def _segment_stream(self):
+        for width in range(0, self.max_width + 1):
+            yield from width_segments(width)
+
+    def _advance_segment(self, slot: Slot) -> bool:
+        """Move the slot to its next width segment; False = exhausted."""
+        for vw, lo, hi, extra in slot._segments:
+            slot.vw = vw
+            slot.seg_hi = hi
+            slot.extra = extra
+            slot.chunk0 = lo
+            slot.spec = build_tail_spec(slot.nonce, vw, self.model, extra)
+            return True
+        return False
+
+    @staticmethod
+    def _group_key(slot: Slot):
+        # slots sharing a tail layout can share one compiled program;
+        # the spec's (n_blocks, tb_loc, chunk_locs) IS the layout key
+        # the single-slot dynamic regime already compiles on
+        spec = slot.spec
+        return (spec.n_blocks, spec.tb_loc, spec.chunk_locs)
+
+    # -- the device loop ----------------------------------------------------
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                with self._cond:
+                    self._reap_locked(self._active)
+                    self._reap_locked(self._pending)
+                    self._admit_locked()
+                    group = self._pick_locked()
+                    if group is None:
+                        self._cond.wait(timeout=_IDLE_TICK_S)
+                        continue
+                self._launch(group)
+        except Exception as exc:  # the loop must never die silently
+            log.exception("batching scheduler device loop died: %s", exc)
+            metrics.inc("sched.loop_failures")
+            RECORDER.record("sched.loop_failure", error=str(exc))
+            with self._cond:
+                self._dead = True
+                slots = self._pending + self._active
+                self._pending = []
+                self._active = []
+                self._publish_gauges_locked()
+            for s in slots:
+                self._finish(s, None, error=f"scheduler loop died: {exc}")
+
+    def _publish_gauges_locked(self) -> None:
+        metrics.gauge("sched.active_slots", len(self._active))
+        metrics.gauge("sched.run_queue_depth", len(self._pending))
+
+    def _reap_locked(self, slots: List[Slot]) -> None:
+        for s in list(slots):
+            if s.cancel_requested():
+                slots.remove(s)
+                metrics.inc("search.cancelled")
+                self._finish(s, None)
+        self._publish_gauges_locked()
+
+    def _admit_locked(self) -> None:
+        self._pending.sort(key=lambda s: (s.vtime, s.seq))
+        while self._pending and len(self._active) < self.max_slots:
+            self._active.append(self._pending.pop(0))
+        if self._pending and self._active:
+            # oversubscribed: preempt the most-served active slot once
+            # it is a full quantum ahead of the queue head — bounded
+            # round-robin between the overflow set, at most one swap
+            # per boundary so the table never thrashes
+            head = self._pending[0]
+            victim = max(self._active, key=lambda s: (s.vtime, s.seq))
+            if victim.vtime >= head.vtime + self.batch / victim.weight:
+                self._active.remove(victim)
+                self._pending.append(victim)
+                self._active.append(self._pending.pop(0))
+                metrics.inc("sched.slots_preempted")
+                RECORDER.record(
+                    "sched.slot_preempt", slot=victim.seq,
+                    for_slot=head.seq, vtime=round(victim.vtime, 1),
+                )
+        self._publish_gauges_locked()
+
+    def _pick_locked(self) -> Optional[List[Slot]]:
+        if not self._active:
+            return None
+        leader = min(self._active, key=lambda s: (s.vtime, s.seq))
+        key = self._group_key(leader)
+        cohort = sorted(
+            (s for s in self._active if self._group_key(s) == key),
+            key=lambda s: (s.vtime, s.seq),
+        )
+        return cohort[: self.max_slots]
+
+    def _launch(self, group: List[Slot]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        n = len(group)
+        n_pad = 1 << (n - 1).bit_length()
+        lanes = group + [group[-1]] * (n_pad - n)
+        spec = group[0].spec
+        init = jnp.asarray([s.spec.init_state for s in lanes], jnp.uint32)
+        base = jnp.asarray([s.spec.base_words for s in lanes], jnp.uint32)
+        masks = jnp.asarray([s.masks for s in lanes], jnp.uint32)
+        tb_lo = jnp.asarray([s.tb_lo for s in lanes], jnp.uint32)
+        log_tbc = jnp.asarray([s.log_tbc for s in lanes], jnp.uint32)
+        chunk0 = jnp.asarray([s.chunk0 & 0xFFFFFFFF for s in lanes],
+                             jnp.uint32)
+        compile_key = (self.model.name, spec.n_blocks, spec.tb_loc,
+                       spec.chunk_locs, self.batch, n_pad)
+        first_compile = compile_key not in self._compiled
+        step = slot_search_step(
+            self.model.name, spec.n_blocks, spec.tb_loc, spec.chunk_locs,
+            self.batch, n_pad,
+        )
+        now = time.monotonic()
+        with WATCHDOG.active():
+            WATCHDOG.beat()
+            if first_compile:
+                self._compiled.add(compile_key)
+                with WATCHDOG.grace(FIRST_COMPILE_GRACE_S):
+                    res = jax.device_get(
+                        step(init, base, masks, tb_lo, log_tbc, chunk0)
+                    )
+            else:
+                res = jax.device_get(
+                    step(init, base, masks, tb_lo, log_tbc, chunk0)
+                )
+
+        metrics.observe("sched.batch_occupancy", n)
+        metrics.inc("sched.launches")
+        metrics.inc("search.hashes", n * self.batch)
+        finished: List[Tuple[Slot, Optional[bytes]]] = []
+        for i, s in enumerate(group):
+            s.launches += 1
+            s.vtime += self.batch / s.weight
+            if s.first_launch_t is None:
+                s.first_launch_t = now
+                metrics.observe("sched.slot_wait_s", now - s.submitted_t)
+            f = int(res[i])
+            if f != SENTINEL:
+                secret, _ = assemble_secret(
+                    s.chunk0, f, s.vw, s.extra, s.tb_lo, s.tbc
+                )
+                if not puzzle.check_secret(s.nonce, secret, s.ntz,
+                                           self.model.name):
+                    # kernel/oracle divergence: fail THIS slot loudly,
+                    # keep the loop serving the others (the solo driver
+                    # kills its whole miner thread here)
+                    finished.append((s, None))
+                    s.error = (
+                        f"packed step returned non-solving candidate "
+                        f"{secret.hex()} (kernel/oracle divergence)"
+                    )
+                    continue
+                metrics.inc("search.found")
+                finished.append((s, secret))
+                continue
+            s.chunk0 += self.batch >> s.log_tbc
+            if s.chunk0 >= s.seg_hi and not self._advance_segment(s):
+                s.exhausted = True
+                finished.append((s, None))
+        with self._cond:
+            for s, _ in finished:
+                if s in self._active:
+                    self._active.remove(s)
+            self._publish_gauges_locked()
+        for s, secret in finished:
+            self._finish(s, secret, error=s.error)
+
+    def _finish(self, slot: Slot, secret: Optional[bytes],
+                error: Optional[str] = None) -> None:
+        slot.secret = secret
+        slot.error = error
+        slot.done.set()

@@ -17,7 +17,11 @@
 # plumbing (stage order, anomaly screen, last_measured write) end to
 # end before the next hardware window, without touching the checked-in
 # hardware provenance (VERDICT r5 weak #3).  ~3-6 min of CPU compiles.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal]
+# `--sched-smoke` runs the deterministic continuous-batching smoke
+# (scripts/sched_smoke.py, docs/SCHEDULER.md): K concurrent Mines on
+# one CPU worker must batch (mean occupancy > 1), coalesce duplicates,
+# and drain — ~30 s.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -42,6 +46,13 @@ run_lint() {
 # the static gate needs no native build — run and exit early
 if [ "${1:-}" = "--lint" ]; then
   run_lint
+  exit 0
+fi
+
+if [ "${1:-}" = "--sched-smoke" ]; then
+  echo "=== scheduler smoke (continuous batching, CPU platform) ==="
+  JAX_PLATFORMS=cpu python scripts/sched_smoke.py
+  echo "=== sched smoke OK ==="
   exit 0
 fi
 
@@ -83,7 +94,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke]" >&2
           exit 2 ;;
 esac
 

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Deterministic multi-request scheduler smoke (scripts/ci.sh --sched-smoke).
+
+Boots a real in-process stack — coordinator (coalescing + admission
+control on) + ONE jax-backend worker with Scheduler="batching" — on the
+CPU platform, fires K concurrent same-difficulty Mine requests plus one
+duplicate pair, and asserts the serving plane actually served:
+
+* every request completed with a host-verified secret;
+* the batch-occupancy histogram shows shared launches (mean > 1);
+* the duplicate pair coalesced into the leader's round;
+* no request degraded and the slot table drained to zero.
+
+Prints one JSON summary line on stdout (details to stderr); exit 0 on
+success — the shape scripts/chaos_smoke.py established for CI lanes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distpow_tpu.models import puzzle  # noqa: E402
+from distpow_tpu.nodes import Client, Coordinator, Worker  # noqa: E402
+from distpow_tpu.runtime.config import (  # noqa: E402
+    ClientConfig,
+    CoordinatorConfig,
+    WorkerConfig,
+)
+from distpow_tpu.runtime.metrics import REGISTRY  # noqa: E402
+
+K = int(os.environ.get("SCHED_SMOKE_REQUESTS", "8"))
+NTZ = 3
+
+
+def main() -> int:
+    coordinator = Coordinator(CoordinatorConfig(
+        ClientAPIListenAddr="127.0.0.1:0",
+        WorkerAPIListenAddr="127.0.0.1:0",
+        Workers=["pending:0"],
+        SchedMaxInflight=max(K * 2, 16),
+    ))
+    client_addr, worker_api_addr = coordinator.initialize_rpcs()
+    worker = Worker(WorkerConfig(
+        WorkerID="worker1",
+        ListenAddr="127.0.0.1:0",
+        CoordAddr=worker_api_addr,
+        Backend="jax",
+        Scheduler="batching",
+        SchedMaxSlots=K,
+        BatchSize=1 << 10,
+        WarmupNonceLens=[],
+        WarmupWidths=[],
+    ))
+    coordinator.set_worker_addrs([worker.initialize_rpcs()])
+    worker.start_forwarder()
+    client = Client(ClientConfig(ClientID="smoke", CoordAddr=client_addr))
+    client.initialize()
+
+    occ0 = REGISTRY.get_histogram("sched.batch_occupancy") or \
+        {"count": 0, "sum": 0.0}
+    coal0 = REGISTRY.get("sched.coalesced_requests")
+    t0 = time.monotonic()
+    try:
+        for i in range(K):
+            client.mine(bytes([0xC5, i]), NTZ)
+        # a duplicate pair on top: must coalesce into one round
+        client.mine(bytes([0xC5, 0]), NTZ)
+        ok = 0
+        for _ in range(K + 1):
+            res = client.notify_queue.get(timeout=180)
+            if res.error is not None:
+                print(f"[sched-smoke] request failed: {res.error}",
+                      file=sys.stderr)
+                return 1
+            assert puzzle.check_secret(res.nonce, res.secret,
+                                       res.num_trailing_zeros)
+            ok += 1
+        wall_s = time.monotonic() - t0
+        occ1 = REGISTRY.get_histogram("sched.batch_occupancy")
+        launches = occ1["count"] - occ0["count"]
+        mean_occ = (occ1["sum"] - occ0["sum"]) / max(launches, 1)
+        coalesced = REGISTRY.get("sched.coalesced_requests") - coal0
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+                REGISTRY.get("sched.active_slots") != 0
+                or REGISTRY.get("sched.run_queue_depth") != 0):
+            time.sleep(0.01)
+        summary = {
+            "requests": ok,
+            "ntz": NTZ,
+            "wall_s": round(wall_s, 3),
+            "launches": launches,
+            "mean_batch_occupancy": round(mean_occ, 3),
+            "coalesced_requests": coalesced,
+            "slots_drained": REGISTRY.get("sched.active_slots") == 0,
+        }
+        print(json.dumps(summary))
+        if mean_occ <= 1:
+            print(f"[sched-smoke] FAIL: no batching observed "
+                  f"(mean occupancy {mean_occ:.2f})", file=sys.stderr)
+            return 1
+        if not summary["slots_drained"]:
+            print("[sched-smoke] FAIL: slot table did not drain",
+                  file=sys.stderr)
+            return 1
+        print(f"[sched-smoke] OK: {ok} requests, {launches} launches, "
+              f"mean occupancy {mean_occ:.2f}, "
+              f"{coalesced} coalesced", file=sys.stderr)
+        return 0
+    finally:
+        client.close()
+        worker.shutdown()
+        coordinator.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Fixture: every unbounded-queue construction form the rule flags."""
+
+import queue
+from queue import Queue, SimpleQueue
+
+
+def build():
+    a = queue.Queue()            # no capacity at all
+    b = Queue(maxsize=0)         # explicit "unbounded" sentinel
+    c = queue.Queue(0)           # positional zero
+    d = SimpleQueue()            # can never be bounded
+    return a, b, c, d

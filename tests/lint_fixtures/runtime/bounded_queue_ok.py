@@ -1,0 +1,14 @@
+"""Fixture: bounded (or justified) queue constructions the rule passes."""
+
+import queue
+from queue import Queue
+
+
+def build(ch_capacity: int):
+    a = queue.Queue(maxsize=10)          # positive literal bound
+    b = Queue(32)                        # positional literal bound
+    c = queue.Queue(maxsize=ch_capacity)  # configured bound (variable)
+    # distpow: ok bounded-queue -- fixture: depth is protocol-bounded
+    d = queue.Queue()
+    e = dict()  # an unrelated call the rule must ignore
+    return a, b, c, d, e

@@ -103,6 +103,8 @@ CASES = [
      os.path.join("ops", "hot_path_host_sync_ok.py"), 5),
     ("silent-except", os.path.join("runtime", "silent_except_bad.py"),
      os.path.join("runtime", "silent_except_ok.py"), 3),
+    ("bounded-queue", os.path.join("runtime", "bounded_queue_bad.py"),
+     os.path.join("runtime", "bounded_queue_ok.py"), 4),
 ]
 
 

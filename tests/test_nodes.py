@@ -30,7 +30,7 @@ class Stack:
     def __init__(self, n_workers: int, backend: str = "python", difficulty_model="md5",
                  coord_cache_file: str = "", failure_policy: str = "error",
                  failure_probe_secs: float = 0.2, sink_factory=None,
-                 worker_extra: dict = None):
+                 worker_extra: dict = None, coord_extra: dict = None):
         sink_factory = sink_factory or (lambda name: MemorySink())
         self._sink_factory = sink_factory
         self.sinks = {"coordinator": sink_factory("coordinator")}
@@ -42,6 +42,7 @@ class Stack:
                 CacheFile=coord_cache_file,
                 FailurePolicy=failure_policy,
                 FailureProbeSecs=failure_probe_secs,
+                **(coord_extra or {}),
             ),
             sink=self.sinks["coordinator"],
         )

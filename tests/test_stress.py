@@ -302,6 +302,68 @@ def test_stress_chaos_worker_death_reassign_journal(tmp_path):
         assert puzzle.check_secret(nonce, secret, 1)
 
 
+def test_scheduler_bounds_contention_pile_up():
+    """ISSUE-4 upgrade of the measure-don't-fix contention test below:
+    with the continuous-batching scheduler enabled, N concurrent Mine
+    requests no longer pile N miner threads into backend.search — the
+    ``worker.active_searches`` gauge the PR-3 test used to RECORD the
+    pile-up must now stay at zero (one engine loop owns the device)
+    while the batch-occupancy histogram shows the requests sharing
+    launches, and every request still completes with a valid secret."""
+    from distpow_tpu.runtime.metrics import REGISTRY
+
+    N = 6
+    s = Stack(1, backend="jax",
+              worker_extra={"Scheduler": "batching", "BatchSize": 1 << 10,
+                            "SchedMaxSlots": N,
+                            "WarmupNonceLens": [], "WarmupWidths": []})
+    occ0 = REGISTRY.get_histogram("sched.batch_occupancy") or \
+        {"count": 0, "sum": 0.0}
+    peak = {"active_searches": 0, "active_slots": 0}
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            peak["active_searches"] = max(
+                peak["active_searches"],
+                REGISTRY.get("worker.active_searches"))
+            peak["active_slots"] = max(
+                peak["active_slots"], REGISTRY.get("sched.active_slots"))
+            time.sleep(0.001)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+    try:
+        client = s.new_client("client1")
+        for i in range(N):
+            client.mine(bytes([0xA0, i]), 3)
+        for _ in range(N):
+            res = client.notify_queue.get(timeout=120)
+            assert res.error is None, res.error
+            assert puzzle.check_secret(res.nonce, res.secret,
+                                       res.num_trailing_zeros)
+    finally:
+        stop.set()
+        sampler.join(timeout=5)
+        s.close()
+    # the pile-up is gone: no miner thread ever entered backend.search
+    assert peak["active_searches"] == 0, peak
+    # ...and the slot table is the bounded replacement signal
+    assert peak["active_slots"] <= N
+    occ1 = REGISTRY.get_histogram("sched.batch_occupancy")
+    count = occ1["count"] - occ0["count"]
+    mean = (occ1["sum"] - occ0["sum"]) / count
+    assert count >= 1 and mean > 1, (count, mean)
+    # drained afterwards: gauges fall back to zero with the load gone
+    deadline = time.time() + 10
+    while time.time() < deadline and (
+            REGISTRY.get("sched.active_slots") != 0
+            or REGISTRY.get("sched.run_queue_depth") != 0):
+        time.sleep(0.01)
+    assert REGISTRY.get("sched.active_slots") == 0
+    assert REGISTRY.get("sched.run_queue_depth") == 0
+
+
 def test_multi_request_contention_on_one_backend_recorded():
     """VERDICT r5 weak #4, measure-don't-fix: N concurrent Mine requests
     pile onto ONE worker's single backend.  The new gauges must record
