@@ -10,6 +10,7 @@ from . import (
     dead_package,
     hot_path_host_sync,
     metrics_registry,
+    serial_rpc_fanout,
     silent_except,
     trace_vocabulary,
 )
@@ -17,6 +18,7 @@ from . import (
 ALL_RULES = (
     blocking_under_lock,
     bounded_queue,
+    serial_rpc_fanout,
     trace_vocabulary,
     metrics_registry,
     config_key_sync,

@@ -37,7 +37,11 @@ def fetch_stats(addr: str, role: str = "auto", timeout: float = 5.0) -> dict:
         "worker": ["WorkerRPCHandler.Stats"],
         "auto": ["CoordRPCHandler.Stats", "WorkerRPCHandler.Stats"],
     }[role]
-    client = RPCClient(addr, timeout=timeout)
+    # pinned to the JSON floor codec: this diagnostic dials a FRESH
+    # connection per fetch (watch mode rides out restarts that way), and
+    # a per-poll rpc.hello would tick the observed node's negotiation
+    # counters — the watcher must not perturb the counters it watches
+    client = RPCClient(addr, timeout=timeout, codec="json")
     try:
         last: Exception = RuntimeError("no services tried")
         for method in services:

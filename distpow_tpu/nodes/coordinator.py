@@ -46,6 +46,22 @@ Documented fixes over the reference (SURVEY.md section 7 "hard parts"):
   new round's 2N-ack ledger — its queues are keyed by (nonce, zeros)
   only.  Dropped-not-counted closes that race end-to-end, including
   messages already in flight on the wire.
+
+Fan-out concurrency (ISSUE 5; docs/RPC.md "Control-plane concurrency"):
+the reference launches one goroutine per worker, and the rebuild used
+to execute the same shape as N *sequential* blocking calls — round
+start, the cancel storm, and abandoned-worker re-sync all cost
+O(N x RTT), and one hung worker head-of-line-blocked the rest for a
+full ``_call_timeout``.  ``_assign_shards`` and ``_broadcast_found``
+now issue every worker RPC as a concurrent ``RPCClient.go()`` future
+before awaiting any reply; under "reassign" the Mine acks are harvested
+OFF the round's critical path (``_harvest_inflight``) so dead/hung
+workers time out in parallel while live workers already mine, with the
+orphan-reassignment and 2N-ack-ledger semantics unchanged — a shard
+whose ack fails (or expires) is dropped from the ledgers and re-issued
+exactly as a failed blocking call was.  The old serial loops survive
+behind ``_serial_fanout`` ($DISTPOW_SERIAL_FANOUT) purely as the
+measurable baseline for ``bench.py --control-plane``.
 """
 
 from __future__ import annotations
@@ -57,6 +73,7 @@ import queue
 import threading
 import time
 import zlib
+from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Dict, List, Optional, Tuple
 
@@ -65,9 +82,9 @@ from ..runtime import actions as act
 from ..runtime.cache import ResultCache
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import CoordinatorConfig
-from ..runtime.rpc import RPCClient, RPCError, RPCServer
+from ..runtime.rpc import RPCClient, RPCError, RPCServer, RPCTransportError
 from ..runtime.telemetry import RECORDER
-from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
+from ..runtime.tracing import Tracer, decode_token, make_tracer, wire_token
 from ..sched.admission import AdmissionReject
 from ..sched.coalesce import Coalescer
 
@@ -242,6 +259,10 @@ class CoordRPCHandler:
         self._sched_max_inflight = int(sched_max_inflight or 0)
         self._sched_retry_after_s = float(sched_retry_after_s)
         self._sched_inflight = 0
+        # serial-baseline knob (module docstring): restores the
+        # one-blocking-call-per-worker fan-out so bench.py
+        # --control-plane can measure the parallel win as a number
+        self._serial_fanout = os.environ.get("DISTPOW_SERIAL_FANOUT") == "1"
 
     # -- task table (coordinator.go:370-388) -------------------------------
     def _task_set(self, key: TaskKey, rid: str, q: "queue.Queue") -> None:
@@ -327,6 +348,11 @@ class CoordRPCHandler:
                 # a hung worker counts as dead: bounded probe.
                 # concurrent.futures.TimeoutError is caught explicitly —
                 # it only aliases the OSError-derived builtin on 3.11+.
+                # distpow: ok serial-rpc-fanout -- deliberately serial:
+                # probes run only while the round is already parked in
+                # results.get, each is bounded to 2 s, and serializing
+                # them keeps the failure detector from stampeding a
+                # cluster that is slow precisely because it is loaded
                 ref.client.call("WorkerRPCHandler.Ping", {}, timeout=2.0)
             except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
                 log.warning("worker %d failed probe: %s", ref.worker_byte, exc)
@@ -478,61 +504,198 @@ class CoordRPCHandler:
             f"coordinator run queue full ({inflight}/{limit})",
         )
 
+    # -- fan-out plumbing (module docstring "Fan-out concurrency") ----------
+    def _go_worker(self, w: WorkerRef, method: str, params: dict) -> Future:
+        """Issue one async worker RPC; a worker with no live client
+        yields an already-failed future so callers treat 'never dialed'
+        exactly like 'send failed'."""
+        if w.client is None:
+            fut: Future = Future()
+            fut.set_exception(
+                RPCTransportError(f"worker {w.worker_byte} not connected")
+            )
+            return fut
+        return w.client.go(method, params)
+
+    def _mine_params(self, trace, nonce: bytes, ntz: int, worker_byte: int,
+                     rid: str) -> dict:
+        return {
+            "nonce": bytes(nonce),
+            "num_trailing_zeros": ntz,
+            "worker_byte": worker_byte,
+            "worker_bits": self.worker_bits,
+            "round": rid,
+            "token": wire_token(trace.generate_token()),
+        }
+
+    def _found_params(self, trace, nonce: bytes, ntz: int, worker_byte: int,
+                      secret: bytes, rid: str) -> dict:
+        return {
+            "nonce": bytes(nonce),
+            "num_trailing_zeros": ntz,
+            "worker_byte": worker_byte,
+            "secret": bytes(secret),
+            "round": rid,
+            "token": wire_token(trace.generate_token()),
+        }
+
+    def _mine_send_failure(self, w: WorkerRef, shard: int, rid: str,
+                           exc: BaseException) -> None:
+        log.warning("worker %d failed Mine for shard %d: %s",
+                    w.worker_byte, shard, exc)
+        metrics.inc("coord.worker_failures")
+        RECORDER.record("coord.worker_failure",
+                        worker_byte=w.worker_byte, shard=shard,
+                        round=rid, error=str(exc))
+        self._mark_dead(w)
+
     def _send_mine(self, trace, nonce: bytes, ntz: int, w: WorkerRef,
                    worker_byte: int, rid: str) -> bool:
-        """Issue one worker Mine; under "reassign" a failure marks the
+        """Issue one worker Mine and BLOCK for its ack (the reissue path
+        and the serial baseline); under "reassign" a failure marks the
         worker dead and returns False instead of raising."""
         trace.record_action(
             act.CoordinatorWorkerMine(
                 nonce=nonce, num_trailing_zeros=ntz, worker_byte=worker_byte,
             )
         )
+        fut = self._go_worker(
+            w, "WorkerRPCHandler.Mine",
+            self._mine_params(trace, nonce, ntz, worker_byte, rid),
+        )
         try:
-            if w.client is None:
-                raise OSError(f"worker {w.worker_byte} not connected")
-            w.client.call(
-                "WorkerRPCHandler.Mine",
-                {
-                    "nonce": list(nonce),
-                    "num_trailing_zeros": ntz,
-                    "worker_byte": worker_byte,
-                    "worker_bits": self.worker_bits,
-                    "round": rid,
-                    "token": encode_token(trace.generate_token()),
-                },
-                timeout=self._call_timeout,
-            )
+            fut.result(timeout=self._call_timeout)
             return True
         except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
             if self.failure_policy != "reassign":
                 raise
-            log.warning("worker %d failed Mine for shard %d: %s",
-                        w.worker_byte, worker_byte, exc)
-            metrics.inc("coord.worker_failures")
-            RECORDER.record("coord.worker_failure",
-                            worker_byte=w.worker_byte, shard=worker_byte,
-                            round=rid, error=str(exc))
-            self._mark_dead(w)
+            self._mine_send_failure(w, worker_byte, rid, exc)
             return False
 
-    def _assign_shards(self, trace, nonce: bytes, ntz: int, rid: str):
-        """Fan the shard per worker (coordinator.go:179-199); under
-        "reassign", shards of dead workers go to live ones (a worker can
-        mine a foreign worker_byte — the partition travels in the RPC).
-        Returns (tasks, pending_unplaced_shards)."""
-        tasks: List[Tuple[WorkerRef, int]] = []
+    def _harvest_inflight(self, inflight: List[tuple], tasks, ledgers,
+                          rid: str):
+        """Resolve the parallel fan-out's outstanding Mine futures off
+        the round's critical path.  A confirmed ack just leaves the
+        in-flight list; a failed future — or one still pending past its
+        deadline (the hung-worker case the serial path paid
+        ``_call_timeout`` for, per worker, before the round even
+        started) — marks the worker dead, drops the shard from the
+        given ack ledgers, and returns it for re-issue.  Returns
+        (surviving_tasks, orphaned_shards)."""
+        if not inflight:
+            return tasks, []
         orphans: List[int] = []
-        for w in self.workers:
-            if self._send_mine(trace, nonce, ntz, w, w.worker_byte, rid):
-                tasks.append((w, w.worker_byte))
+        now = time.monotonic()
+        for entry in list(inflight):
+            w, shard, fut, deadline = entry
+            exc: Optional[BaseException] = None
+            if fut.done():
+                try:
+                    fut.result()
+                    inflight.remove(entry)
+                    continue  # ack confirmed
+                except (OSError, RPCError, RuntimeError, FutureTimeout) as e:
+                    exc = e
+            elif now < deadline:
+                continue  # still within its (parallel) timeout window
             else:
-                orphans.append(w.worker_byte)
+                exc = FutureTimeout(
+                    f"Mine ack from worker {w.worker_byte} still pending "
+                    f"after {self._call_timeout}s"
+                )
+            inflight.remove(entry)
+            if (w, shard) not in tasks:
+                # _reap_dead already killed this worker in an earlier
+                # probe cycle (its ping timed out, closing the client —
+                # which is exactly what failed this future) and the
+                # shard was reassigned then.  Re-orphaning it here would
+                # duplicate the (worker, shard) task entry and owe the
+                # 2N-ack ledger acks the worker can never send — a
+                # forever-spinning drain loop (review PR 5, reproduced
+                # with a fully-hung worker and a >2s round).  The shard
+                # number may still key a LIVE reassigned entry, so the
+                # ledgers must not be touched either.
+                continue
+            self._mine_send_failure(w, shard, rid, exc)
+            tasks = [t for t in tasks if t != (w, shard)]
+            for ledger in ledgers:
+                ledger.pop(shard, None)
+            orphans.append(shard)
+        return tasks, orphans
+
+    def _assign_shards(self, trace, nonce: bytes, ntz: int, rid: str):
+        """Fan the shard per worker (coordinator.go:179-199) — every
+        Mine issued as a concurrent ``go()`` future before any reply is
+        awaited; under "reassign", shards of dead workers go to live
+        ones (a worker can mine a foreign worker_byte — the partition
+        travels in the RPC).  Returns (tasks, pending_unplaced_shards,
+        inflight_mine_acks)."""
+        reassign = self.failure_policy == "reassign"
+        if self._serial_fanout:
+            # serial baseline (bench.py --control-plane): the old
+            # one-blocking-call-per-worker loop, kept measurable
+            tasks: List[Tuple[WorkerRef, int]] = []
+            orphans: List[int] = []
+            for w in self.workers:
+                if self._send_mine(trace, nonce, ntz, w, w.worker_byte, rid):
+                    tasks.append((w, w.worker_byte))
+                else:
+                    orphans.append(w.worker_byte)
+            tasks, pending = self._issue_shards(
+                trace, nonce, ntz, tasks, orphans, rid
+            )
+            if not tasks:
+                raise RuntimeError("no live workers to mine on")
+            return tasks, pending, []
+        futs = []
+        for w in self.workers:
+            trace.record_action(
+                act.CoordinatorWorkerMine(
+                    nonce=nonce, num_trailing_zeros=ntz,
+                    worker_byte=w.worker_byte,
+                )
+            )
+            futs.append((w, w.worker_byte, self._go_worker(
+                w, "WorkerRPCHandler.Mine",
+                self._mine_params(trace, nonce, ntz, w.worker_byte, rid),
+            )))
+        if not reassign:
+            # reference parity ("error"): every worker must take
+            # delivery before the round proceeds — but the N sends
+            # already overlapped, so N round trips cost ~one RTT
+            tasks = []
+            for w, shard, fut in futs:
+                fut.result()  # any failure fails the Mine RPC, as before
+                tasks.append((w, shard))
+            return tasks, [], []
+        tasks, orphans, inflight = [], [], []
+        deadline = time.monotonic() + (self._call_timeout or 10.0)
+        for w, shard, fut in futs:
+            if fut.done():
+                # resolved at issue time: either a send-path transport
+                # failure (dead TCP fails inside go()) or an already-
+                # arrived ack
+                try:
+                    fut.result()
+                    tasks.append((w, shard))
+                except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
+                    self._mine_send_failure(w, shard, rid, exc)
+                    orphans.append(shard)
+            else:
+                # optimistic placement: the frame is written, only the
+                # ack is outstanding.  The round starts NOW; the ack is
+                # confirmed (or timed out, in parallel with its peers)
+                # by _harvest_inflight during the result waits — a hung
+                # worker no longer adds _call_timeout to
+                # fanout->first-result for the live ones
+                tasks.append((w, shard))
+                inflight.append((w, shard, fut, deadline))
         tasks, pending = self._issue_shards(
             trace, nonce, ntz, tasks, orphans, rid
         )
         if not tasks:
             raise RuntimeError("no live workers to mine on")
-        return tasks, pending
+        return tasks, pending, inflight
 
     def _mine_miss(self, trace, nonce: bytes, ntz: int) -> dict:
         self._initialize_workers()
@@ -567,10 +730,11 @@ class CoordRPCHandler:
         fanout_t0 = time.monotonic()
         RECORDER.record("coord.fanout", round=rid, nonce=nonce.hex(),
                         ntz=ntz)
-        tasks, pending = self._assign_shards(trace, nonce, ntz, rid)
+        tasks, pending, inflight = self._assign_shards(trace, nonce, ntz, rid)
 
         # first-result-wins (coordinator.go:202-206); under "reassign",
-        # waiting is interleaved with liveness probes; orphaned and
+        # waiting is interleaved with liveness probes AND the harvest of
+        # the parallel fan-out's outstanding Mine acks; orphaned and
         # not-yet-placed shards are re-issued every round so coverage is
         # never silently lost
         while True:
@@ -578,11 +742,12 @@ class CoordRPCHandler:
                 first = results.get(timeout=probe_t)
                 break
             except queue.Empty:
+                tasks, hung = self._harvest_inflight(inflight, tasks, (), rid)
                 tasks, orphans = self._reap_dead(tasks, ())
                 if not tasks:
                     raise RuntimeError("all workers died while mining")
                 tasks, pending = self._issue_shards(
-                    trace, nonce, ntz, tasks, pending + orphans, rid
+                    trace, nonce, ntz, tasks, pending + hung + orphans, rid
                 )
         first_result_s = time.monotonic() - fanout_t0
         metrics.observe("coord.first_result_s", first_result_s)
@@ -612,6 +777,9 @@ class CoordRPCHandler:
             try:
                 msg = results.get(timeout=probe_t)
             except queue.Empty:
+                tasks, _ = self._harvest_inflight(
+                    inflight, tasks, (remaining,), rid
+                )
                 tasks, _ = self._reap_dead(tasks, (remaining,))
                 continue
             if msg["secret"] is not None:
@@ -642,6 +810,9 @@ class CoordRPCHandler:
                 try:
                     m = results.get(timeout=probe_t)
                 except queue.Empty:
+                    tasks, _ = self._harvest_inflight(
+                        inflight, tasks, (owed,), rid
+                    )
                     tasks, _ = self._reap_dead(tasks, (owed,))
                     continue
                 b = int(m["worker_byte"])
@@ -649,43 +820,113 @@ class CoordRPCHandler:
                     owed[b] -= 1
 
         if reassign:
-            self._cancel_abandoned(trace, nonce, ntz, winner, tasks, rid)
+            alive = {id(w) for w, _ in tasks}
+            abandoned = [w for w in self.workers if id(w) not in alive]
+            if abandoned:
+                # OFF the success-reply critical path (ISSUE 5 satellite:
+                # the inline re-dial used to sit between the drained
+                # ledger and the client's reply): bounded background
+                # best-effort re-sync, one flight-recorder event per
+                # outcome
+                threading.Thread(
+                    target=self._resync_abandoned,
+                    args=(trace, nonce, ntz, winner, abandoned, rid),
+                    daemon=True, name=f"resync-{rid[-8:]}",
+                ).start()
         return self._success_reply(trace, nonce, ntz, winner)
 
-    def _cancel_abandoned(self, trace, nonce: bytes, ntz: int,
-                          secret: bytes, tasks, rid: str) -> None:
+    #: total wall-clock budget for one round's abandoned-worker re-sync
+    #: (dials + Found calls share it); generous vs the 2 s dial timeout
+    #: yet small enough that a stack teardown never waits on stragglers
+    RESYNC_CAP_S = 8.0
+    RESYNC_DIAL_TIMEOUT_S = 2.0
+
+    def _resync_abandoned(self, trace, nonce: bytes, ntz: int,
+                          secret: bytes, workers: List[WorkerRef],
+                          rid: str) -> None:
         """Best-effort Found to every worker not among the surviving
         tasks.  A worker falsely marked dead on a transient failure still
         has miner threads running (and a finder may be blocked waiting for
         its Found); once the blip heals, this installs the winning secret
         — which also self-cancels its orphaned miners via the worker's
         cache-aware cancel check — and unblocks any waiting finder.
-        Failures are ignored: a truly dead worker has nothing running."""
-        alive = {id(w) for w, _ in tasks}
-        for w in self.workers:
-            if id(w) in alive:
-                continue
+        Failures are ignored: a truly dead worker has nothing running.
+
+        Runs on a background thread, one sub-thread per worker, all
+        capped by RESYNC_CAP_S: the re-dial of a black-holed address can
+        no longer add its connect timeout to the Mine reply, and total
+        re-sync time is bounded no matter how many workers are down.
+        Dials are THROWAWAY clients — installing one on the WorkerRef
+        here would race the next round's ``_initialize_workers``."""
+        deadline = time.monotonic() + self.RESYNC_CAP_S
+
+        def resync_one(w: WorkerRef) -> None:
+            t0 = time.monotonic()
+            outcome = "resynced"
+            client = temp = None
             try:
-                if w.client is None:
-                    w.client = RPCClient(w.addr, timeout=2.0)
-                w.client.call(
-                    "WorkerRPCHandler.Found",
-                    {
-                        "nonce": list(nonce),
-                        "num_trailing_zeros": ntz,
-                        "worker_byte": w.worker_byte,
-                        "secret": list(secret),
-                        "round": rid,
-                        "token": encode_token(trace.generate_token()),
-                    },
-                    timeout=self._call_timeout,
-                )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    outcome = "deadline"
+                    return
+                client, temp = w.client, None
+                if client is None:
+                    temp = client = RPCClient(
+                        w.addr,
+                        timeout=min(self.RESYNC_DIAL_TIMEOUT_S, remaining),
+                    )
+                try:
+                    client.call(
+                        "WorkerRPCHandler.Found",
+                        self._found_params(trace, nonce, ntz, w.worker_byte,
+                                           secret, rid),
+                        timeout=max(0.1, deadline - time.monotonic()),
+                    )
+                finally:
+                    if temp is not None:
+                        temp.close()
                 log.info("abandoned worker %d cancelled and re-synced",
                          w.worker_byte)
             except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
+                outcome = "unreachable"
                 log.info("abandoned worker %d still unreachable: %s",
                          w.worker_byte, exc)
-                self._mark_dead(w)
+                # only tear down the client THIS thread observed failing:
+                # the next round's _initialize_workers may have installed
+                # a fresh healthy connection while this (post-reply,
+                # seconds-long) background attempt was in flight, and
+                # _mark_dead-ing that one would spuriously fail a live
+                # worker's round (review PR 5)
+                if temp is None and client is not None and \
+                        w.client is client:
+                    self._mark_dead(w)
+            finally:
+                metrics.inc("coord.abandoned_resyncs")
+                RECORDER.record(
+                    "coord.abandoned_resync", worker_byte=w.worker_byte,
+                    round=rid, outcome=outcome,
+                    latency_s=round(time.monotonic() - t0, 6),
+                )
+
+        for w in workers:
+            threading.Thread(target=resync_one, args=(w,), daemon=True,
+                             name=f"resync-{rid[-8:]}-w{w.worker_byte}"
+                             ).start()
+
+    def _await_found(self, w: WorkerRef, shard: int, fut: Future,
+                     timeout: Optional[float]) -> bool:
+        """Confirm one Found delivery; under "reassign" a failure (or a
+        deadline expiry) marks the worker dead and returns False."""
+        try:
+            fut.result(timeout=timeout)
+            return True
+        except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
+            if self.failure_policy != "reassign":
+                raise
+            log.warning("worker %d failed Found for shard %d: %s",
+                        w.worker_byte, shard, exc)
+            self._mark_dead(w)
+            return False
 
     def _broadcast_found(
         self,
@@ -697,7 +938,11 @@ class CoordRPCHandler:
         rid: str,
     ) -> List[Tuple[WorkerRef, int]]:
         """Found-as-cancel+cache-install per task (coordinator.go:210-230);
-        returns the tasks whose worker took delivery."""
+        returns the tasks whose worker took delivery.  All Founds are
+        issued before any reply is awaited, so the cancel storm costs
+        ~one RTT instead of N, and every straggler shares ONE deadline
+        instead of timing out head-of-line, one after another."""
+        issued: List[Tuple[WorkerRef, int, Future]] = []
         delivered: List[Tuple[WorkerRef, int]] = []
         for w, shard in tasks:
             trace.record_action(
@@ -705,28 +950,23 @@ class CoordRPCHandler:
                     nonce=nonce, num_trailing_zeros=ntz, worker_byte=shard,
                 )
             )
-            try:
-                if w.client is None:
-                    raise OSError(f"worker {w.worker_byte} not connected")
-                w.client.call(
-                    "WorkerRPCHandler.Found",
-                    {
-                        "nonce": list(nonce),
-                        "num_trailing_zeros": ntz,
-                        "worker_byte": shard,
-                        "secret": list(secret),
-                        "round": rid,
-                        "token": encode_token(trace.generate_token()),
-                    },
-                    timeout=self._call_timeout,
-                )
+            fut = self._go_worker(
+                w, "WorkerRPCHandler.Found",
+                self._found_params(trace, nonce, ntz, shard, secret, rid),
+            )
+            if self._serial_fanout:
+                # serial baseline: confirm before the next Found goes out
+                if self._await_found(w, shard, fut, self._call_timeout):
+                    delivered.append((w, shard))
+            else:
+                issued.append((w, shard, fut))
+        deadline = (None if self._call_timeout is None
+                    else time.monotonic() + self._call_timeout)
+        for w, shard, fut in issued:
+            timeout = (None if deadline is None
+                       else max(0.0, deadline - time.monotonic()))
+            if self._await_found(w, shard, fut, timeout):
                 delivered.append((w, shard))
-            except (OSError, RPCError, RuntimeError, FutureTimeout) as exc:
-                if self.failure_policy != "reassign":
-                    raise
-                log.warning("worker %d failed Found for shard %d: %s",
-                            w.worker_byte, shard, exc)
-                self._mark_dead(w)
         return delivered
 
     def _success_reply(self, trace, nonce: bytes, ntz: int, secret: bytes) -> dict:
@@ -736,10 +976,10 @@ class CoordRPCHandler:
             )
         )
         return {
-            "nonce": list(nonce),
+            "nonce": bytes(nonce),
             "num_trailing_zeros": ntz,
-            "secret": list(secret),
-            "token": encode_token(trace.generate_token()),
+            "secret": bytes(secret),
+            "token": wire_token(trace.generate_token()),
         }
 
     def Result(self, params) -> dict:

@@ -81,7 +81,7 @@ from ..runtime import actions as act
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.rpc import RPCClient, RPCError, RPCRetryAfter, RPCTransportError
 from ..runtime.telemetry import RECORDER
-from ..runtime.tracing import Tracer, decode_token, encode_token
+from ..runtime.tracing import Tracer, decode_token, wire_token
 
 log = logging.getLogger("distpow.powlib")
 
@@ -222,9 +222,9 @@ class POW:
         fut = client.go(
             "CoordRPCHandler.Mine",
             {
-                "nonce": list(nonce),
+                "nonce": bytes(nonce),
                 "num_trailing_zeros": ntz,
-                "token": encode_token(trace.generate_token()),
+                "token": wire_token(trace.generate_token()),
             },
         )
         return self._await_attempt(fut)

@@ -48,7 +48,7 @@ from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import WorkerConfig
 from ..runtime.rpc import RPCClient, RPCServer
 from ..runtime.telemetry import RECORDER
-from ..runtime.tracing import Tracer, decode_token, encode_token, make_tracer
+from ..runtime.tracing import Tracer, decode_token, make_tracer, wire_token
 from ..runtime.watchdog import WATCHDOG
 
 log = logging.getLogger("distpow.worker")
@@ -290,12 +290,15 @@ class WorkerRPCHandler:
         metrics.inc("worker.results_sent")
         self.result_queue.put(
             {
-                "nonce": list(key[0]),
+                # bytes fields travel raw: wire v2 ships them verbatim,
+                # the JSON codec renders them as the int arrays every
+                # earlier version sent (runtime/rpc.py _json_default)
+                "nonce": bytes(key[0]),
                 "num_trailing_zeros": key[1],
                 "worker_byte": key[2],
-                "secret": list(secret) if secret is not None else None,
+                "secret": bytes(secret) if secret is not None else None,
                 "round": round_id,
-                "token": encode_token(trace.generate_token()),
+                "token": wire_token(trace.generate_token()),
             }
         )
         # forwarder backlog: grows when the coordinator is slow/away

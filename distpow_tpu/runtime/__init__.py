@@ -20,6 +20,7 @@ from .tracing import (
     decode_token,
     encode_token,
     make_tracer,
+    wire_token,
 )
 
 __all__ = [
@@ -28,5 +29,5 @@ __all__ = [
     "read_json_config", "write_json_config",
     "RPCClient", "RPCError", "RPCServer", "RPCTransportError", "TracingServer",
     "FileSink", "MemorySink", "TCPSink", "Trace", "Tracer",
-    "decode_token", "encode_token", "make_tracer",
+    "decode_token", "encode_token", "make_tracer", "wire_token",
 ]

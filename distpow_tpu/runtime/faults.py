@@ -57,6 +57,15 @@ sequential, as the determinism tests arrange.)
 Observability: every injection increments ``faults.injected.<kind>``
 (runtime/metrics.py, shipped by the Stats RPC) and appends a tuple to
 ``FaultPlan.injected`` for test assertions.  See docs/FAULTS.md.
+
+Wire codecs: the per-frame hooks operate on the ENCODED frame, so every
+kind behaves identically on wire v1 (JSON) and wire v2 (binary,
+runtime/wire.py) — a truncated binary frame is a mid-frame reset, a
+duplicated one re-dispatches, exactly as on JSON (tests/test_wire.py
+chaos-on-binary).  The ``rpc.hello`` negotiation exchange itself is NOT
+passed through ``on_frame`` — dial-window faults are modeled by the
+``@connect`` pseudo-method, and a faulted hello would only ever degrade
+to the JSON floor anyway (docs/RPC.md).
 """
 
 from __future__ import annotations

@@ -45,6 +45,14 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
   over to errors, never hangs)
 * ``rpc.handler_errors`` — handler exceptions returned to callers in
   the response frame (runtime/rpc.py _dispatch)
+* ``rpc.codec.negotiated_v2`` / ``rpc.codec.fallback_v1`` — per-
+  connection wire-codec negotiation outcomes (runtime/rpc.py
+  ``rpc.hello``; docs/RPC.md): binary v2 agreed vs transparent JSON
+  fallback against a v1-only peer
+* ``coord.abandoned_resyncs`` — background best-effort Found re-syncs
+  to workers abandoned during a round (nodes/coordinator.py
+  ``_resync_abandoned`` — off the Mine success path; per-outcome
+  detail rides the ``coord.abandoned_resync`` flight-recorder event)
 * ``compile_cache.errors`` (+ ``.read_errors`` / ``.write_errors`` /
   ``.keygen_errors``) — persistent XLA cache failures
   (runtime/compile_cache.py)
@@ -108,6 +116,8 @@ KNOWN_COUNTERS = frozenset({
     "sched.coalesced_requests", "sched.slots_preempted",
     "sched.fallback_searches", "sched.loop_failures",
     "rpc.handler_errors",
+    "rpc.codec.negotiated_v2", "rpc.codec.fallback_v1",
+    "coord.abandoned_resyncs",
     "compile_cache.errors", "compile_cache.read_errors",
     "compile_cache.write_errors", "compile_cache.keygen_errors",
     "telemetry.dropped_events", "telemetry.dumps",

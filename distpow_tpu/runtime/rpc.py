@@ -7,30 +7,50 @@ coordinator.go:195,226), async calls returning a completion handle
 servicing multiple listeners (the coordinator's segregated client/worker
 listeners, coordinator.go:334-351), and concurrent dispatch of requests.
 
-Wire format: 4-byte big-endian length prefix + UTF-8 JSON.
-Request  ``{"id": n, "method": "Service.Method", "params": {...}}``
-Response ``{"id": n, "result": ..., "error": null | str}``
+Wire framing: 4-byte big-endian length prefix + payload.  Two payload
+codecs exist (docs/RPC.md):
 
-Byte fields travel as arrays of ints (the natural JSON form of the
-reference's ``[]uint8``); tracing tokens as base64 strings (see
-runtime/tracing.py).  Within a TPU pod the hot path never touches this
-transport — device fan-out rides ICI collectives (parallel/mesh_search.py);
-this backend carries only control-plane traffic between hosts, as the
-north-star design prescribes (BASELINE.json: "the coordinator/worker RPC
-boundary stays intact").
+* **v1 (JSON)** — UTF-8 JSON, the format every version of this repo has
+  spoken.  Request ``{"id": n, "method": "Service.Method", "params":
+  {...}}``; response ``{"id": n, "result": ..., "error": null | str}``.
+  Byte fields travel as arrays of ints (the natural JSON form of the
+  reference's ``[]uint8``) and tracing tokens as base64 strings —
+  senders pass ``bytes`` and the codec renders both legacy forms
+  (``_json_default`` / ``_jsonify_tokens``), keeping JSON-mode frames
+  byte-identical to pre-v2 versions of this repo.
+* **v2 (binary)** — the struct-packed codec in runtime/wire.py: raw
+  ``bytes`` for nonce/secret/token, interned method and key ids, a
+  dedicated ``retry_after`` header field.  Negotiated PER CONNECTION at
+  dial time: the client sends a plain-JSON ``rpc.hello`` request; a
+  v2-capable server acks it and both sides switch, while any other
+  server answers it like any unknown method — an error frame — and the
+  client transparently stays on JSON.  Mixed-version clusters therefore
+  interoperate with no configuration; ``DISTPOW_RPC_CODEC=json`` pins
+  the process to v1 for A/B measurement (bench.py --control-plane).
+
+The fault-injection plane (runtime/faults.py) mutates the *encoded
+frame* — delay/drop/duplicate/truncate behave identically on both
+codecs — and the ``rpc.frame.{sent,recv}_bytes`` histograms measure the
+payload shrink directly.  Within a TPU pod the hot path never touches
+this transport — device fan-out rides ICI collectives
+(parallel/mesh_search.py); this backend carries only control-plane
+traffic between hosts, as the north-star design prescribes
+(BASELINE.json: "the coordinator/worker RPC boundary stays intact").
 """
 
 from __future__ import annotations
 
+import base64
 import json
+import os
 import socket
 import struct
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
-from . import faults
+from . import faults, wire
 from .metrics import REGISTRY as metrics
 
 
@@ -63,6 +83,83 @@ class RPCRetryAfter(RPCError):
         self.delay_s = float(delay_s)
 
 
+#: pseudo-method of the per-connection codec negotiation exchange.  The
+#: hello rides an ordinary v1 frame so a JSON-only peer handles it as a
+#: normal (unknown-method) request; it is NOT passed through the fault
+#: plane's per-frame hooks — dial-time faults already model the
+#: negotiation window via the ``@connect`` pseudo-method.
+HELLO_METHOD = "rpc.hello"
+HELLO_TIMEOUT_S = 5.0
+
+#: process defaults, overridable per client/server: "auto" negotiates
+#: v2 with transparent JSON fallback; "json" pins v1; "binary" requires
+#: v2 and fails the dial when the peer can't speak it.
+CLIENT_CODEC_DEFAULT = os.environ.get("DISTPOW_RPC_CODEC") or "auto"
+SERVER_NEGOTIATE_DEFAULT = os.environ.get("DISTPOW_RPC_CODEC") != "json"
+
+
+def _json_default(o):
+    """``bytes`` params render as arrays of ints on the JSON wire — the
+    exact frames pre-v2 versions of this repo sent, so a v2 process
+    pinned (or negotiated down) to JSON stays wire-identical."""
+    if isinstance(o, (bytes, bytearray, memoryview)):
+        return list(bytes(o))
+    raise TypeError(f"{type(o).__name__} is not JSON-encodable")
+
+
+def _jsonify_tokens(obj: dict) -> dict:
+    """Tracing tokens travel as base64 strings on the JSON wire — the
+    exact pre-v2 form, which a genuinely old peer's ``decode_token``
+    (base64-only) can parse.  Every OTHER byte field was an int array
+    before v2 and stays one via ``_json_default``; the token is the one
+    field whose legacy form differed, so it alone needs this rewrite
+    (review PR 5: rendering it as an int array would have broken real
+    mixed-version clusters while the in-repo interop tests — both ends
+    current code — stayed green)."""
+    for key in ("params", "result"):
+        inner = obj.get(key)
+        if isinstance(inner, dict) and \
+                isinstance(inner.get("token"), (bytes, bytearray, memoryview)):
+            obj = dict(obj)
+            obj[key] = dict(inner, token=base64.b64encode(
+                bytes(inner["token"])).decode())
+    return obj
+
+
+class _JsonCodec:
+    """Wire v1: UTF-8 JSON payloads."""
+
+    name = "json"
+    version = 1
+
+    @staticmethod
+    def encode(obj: dict) -> bytes:
+        return json.dumps(_jsonify_tokens(obj), default=_json_default).encode()
+
+    @staticmethod
+    def decode(data: bytes):
+        return json.loads(data.decode())
+
+
+class _BinaryCodec:
+    """Wire v2: the struct-packed codec (runtime/wire.py)."""
+
+    name = "binary"
+    version = wire.WIRE_VERSION
+
+    @staticmethod
+    def encode(obj: dict) -> bytes:
+        return wire.encode_frame(obj)
+
+    @staticmethod
+    def decode(data: bytes) -> dict:
+        return wire.decode_frame(data)
+
+
+JSON_CODEC = _JsonCodec()
+BINARY_CODEC = _BinaryCodec()
+
+
 def _read_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
     while len(buf) < n:
@@ -73,16 +170,17 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _read_frame(sock: socket.socket) -> dict:
+def _read_frame(sock: socket.socket, codec=JSON_CODEC) -> dict:
     (length,) = struct.unpack(">I", _read_exact(sock, 4))
     if length > 64 * 1024 * 1024:
         raise RPCError(f"oversized frame: {length} bytes")
     metrics.observe("rpc.frame.recv_bytes", length)
-    return json.loads(_read_exact(sock, length).decode())
+    return codec.decode(_read_exact(sock, length))
 
 
-def _write_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
-    payload = json.dumps(obj).encode()
+def _write_frame(sock: socket.socket, obj: dict, lock: threading.Lock,
+                 codec=JSON_CODEC) -> None:
+    payload = codec.encode(obj)
     metrics.observe("rpc.frame.sent_bytes", len(payload))
     with lock:
         # distpow: ok no-blocking-under-lock -- this lock IS the frame
@@ -92,12 +190,13 @@ def _write_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
 
 
 def _write_truncated(sock: socket.socket, obj: dict,
-                     lock: threading.Lock) -> None:
+                     lock: threading.Lock, codec=JSON_CODEC) -> None:
     """Fault-plane helper (faults.py kind="truncate"): write a partial
     frame — length prefix plus roughly half the payload — so the peer's
     ``_read_exact`` sees a mid-frame connection reset when the caller
-    tears the socket down right after."""
-    payload = json.dumps(obj).encode()
+    tears the socket down right after.  Codec-agnostic: the tear is at
+    the byte level, exactly like a real mid-frame reset."""
+    payload = codec.encode(obj)
     frame = struct.pack(">I", len(payload)) + payload
     try:
         with lock:
@@ -130,9 +229,17 @@ class RPCServer:
     own worker thread so slow handlers (the coordinator's blocking ``Mine``)
     never head-of-line-block other requests on the same connection —
     matching Go net/rpc's goroutine-per-request semantics.
+
+    ``negotiate`` (default: module ``SERVER_NEGOTIATE_DEFAULT``) governs
+    wire-v2 negotiation: when False the server is JSON-only and an
+    incoming ``rpc.hello`` falls through to normal dispatch — the
+    unknown-service error a pre-v2 server would return, which is
+    exactly the reply that makes v2 clients fall back transparently.
     """
 
-    def __init__(self):
+    def __init__(self, negotiate: Optional[bool] = None):
+        self._negotiate = (SERVER_NEGOTIATE_DEFAULT
+                           if negotiate is None else bool(negotiate))
         self._services: Dict[str, object] = {}
         self._listeners = []
         self._threads = []
@@ -179,22 +286,33 @@ class RPCServer:
 
     def _conn_loop(self, conn: socket.socket) -> None:
         wlock = threading.Lock()
+        # per-connection codec, shared with this connection's dispatch
+        # threads via a one-slot holder; flipped only by the hello
+        # exchange below, which the client sends before any other frame
+        codec: List[object] = [JSON_CODEC]
         try:
             peer = "%s:%s" % conn.getpeername()[:2]
         except OSError:
             peer = ""
         try:
             while True:
-                req = _read_frame(conn)
+                req = _read_frame(conn, codec[0])
                 if not isinstance(req, dict):
                     # valid JSON, wrong shape (e.g. a bare number):
                     # drop the connection rather than crash the
                     # dispatch thread on req.get (adversarial-input
                     # hardening, round 4)
                     raise RPCError(f"non-object frame: {type(req).__name__}")
+                if self._negotiate and req.get("method") == HELLO_METHOD:
+                    # answered INLINE on the reader thread: the ack must
+                    # hit the wire before any frame of the new codec is
+                    # read, and the handshake is the connection's first
+                    # exchange so nothing else can be in flight
+                    self._handle_hello(conn, wlock, req, codec)
+                    continue
                 threading.Thread(
                     target=self._dispatch,
-                    args=(conn, wlock, req, peer),
+                    args=(conn, wlock, req, peer, codec),
                     daemon=True,
                 ).start()
         except (ConnectionError, OSError, ValueError, RPCError):
@@ -212,7 +330,30 @@ class RPCServer:
             except OSError:
                 pass
 
-    def _dispatch(self, conn, wlock, req: dict, peer: str = "") -> None:
+    def _handle_hello(self, conn, wlock, req: dict, codec: List[object]) -> None:
+        """Codec negotiation (docs/RPC.md): ack a supported version and
+        flip this connection to the binary codec; anything else gets an
+        error frame and the connection stays on JSON.  The hello itself
+        always travels as v1 in both directions."""
+        want = req.get("params") or {}
+        version = want.get("codec") if isinstance(want, dict) else None
+        if version == wire.WIRE_VERSION:
+            resp = {"id": req.get("id"),
+                    "result": {"codec": wire.WIRE_VERSION}, "error": None}
+        else:
+            resp = {"id": req.get("id"), "result": None,
+                    "error": f"RPCError: unsupported wire codec {version!r}"}
+        try:
+            _write_frame(conn, resp, wlock, JSON_CODEC)
+        except OSError:
+            return
+        if resp["error"] is None:
+            codec[0] = BINARY_CODEC
+            metrics.inc("rpc.codec.negotiated_v2")
+
+    def _dispatch(self, conn, wlock, req: dict, peer: str = "",
+                  codec: Optional[List[object]] = None) -> None:
+        codec = codec or [JSON_CODEC]
         rid = req.get("id")
         try:
             service_name, _, method_name = req["method"].partition(".")
@@ -262,15 +403,15 @@ class RPCServer:
                     return  # response silently never sent
                 elif kind == "duplicate":
                     try:
-                        _write_frame(conn, resp, wlock)
-                        _write_frame(conn, resp, wlock)
+                        _write_frame(conn, resp, wlock, codec[0])
+                        _write_frame(conn, resp, wlock, codec[0])
                     except OSError:
                         pass
                     return
                 elif kind == "truncate":
                     # partial response, then reset: the peer's pending
                     # calls on this connection all fail fast
-                    _write_truncated(conn, resp, wlock)
+                    _write_truncated(conn, resp, wlock, codec[0])
                     for op in (lambda: conn.shutdown(socket.SHUT_RDWR),
                                conn.close):
                         try:
@@ -279,7 +420,7 @@ class RPCServer:
                             pass
                     return
         try:
-            _write_frame(conn, resp, wlock)
+            _write_frame(conn, resp, wlock, codec[0])
         except OSError:
             pass
 
@@ -345,33 +486,104 @@ class RPCClient:
     """
 
     def __init__(self, addr: str, timeout: Optional[float] = 10.0,
-                 send_timeout: float = 20.0):
+                 send_timeout: float = 20.0, codec: Optional[str] = None):
         self._addr = addr
+        self._dial_timeout = timeout
+        self._send_timeout = send_timeout
         if faults.PLAN is not None:
             faults.PLAN.on_connect(addr)  # may delay or refuse the dial
-        self._sock = socket.create_connection(split_addr(addr), timeout=timeout)
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        if send_timeout:
-            sec = int(send_timeout)
-            usec = int((send_timeout - sec) * 1e6)
-            self._sock.setsockopt(
-                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-                struct.pack("ll", sec, usec),
-            )
+        self._sock = self._dial()
         self._wlock = threading.Lock()
         self._pending: Dict[int, Future] = {}
         self._plock = threading.Lock()
         self._next_id = 0
         self._closed = False
         self._dead: Optional[RPCError] = None  # set by the reader on death
+        # wire codec (module docstring): negotiated synchronously BEFORE
+        # the reader thread exists, so reader and senders always agree
+        mode = codec or CLIENT_CODEC_DEFAULT
+        if mode not in ("auto", "json", "binary"):
+            raise ValueError(f"unknown rpc codec mode {mode!r}")
+        self._codec = JSON_CODEC if mode == "json" else \
+            self._negotiate_codec(mode)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection(split_addr(self._addr),
+                                        timeout=self._dial_timeout)
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._send_timeout:
+            sec = int(self._send_timeout)
+            usec = int((self._send_timeout - sec) * 1e6)
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", sec, usec),
+            )
+        return sock
+
+    def _negotiate_codec(self, mode: str):
+        """One v1 round trip: ``rpc.hello`` → ack means wire v2; a
+        pre-v2 server's unknown-method error means stay on JSON.  A
+        TIMED-OUT or garbled handshake tears this socket down and
+        re-dials a fresh one with no hello: a slow v2 server may still
+        ack (and flip ITS side to binary) after we give up, so reusing
+        the socket could split-brain the codec — or leave a
+        partially-read ack desynchronizing the length-prefixed stream —
+        while the fresh hello-less connection is v1 on both sides by
+        construction.  Connection-level failures propagate like any
+        other dial failure.  ``mode == "binary"`` turns any fallback
+        into an error instead."""
+        hello = {"id": 0, "method": HELLO_METHOD,
+                 "params": {"codec": wire.WIRE_VERSION}}
+        resp = None
+        redial = False
+        try:
+            self._sock.settimeout(HELLO_TIMEOUT_S)
+            try:
+                _write_frame(self._sock, hello, self._wlock, JSON_CODEC)
+                resp = _read_frame(self._sock, JSON_CODEC)
+            except (TimeoutError, socket.timeout):
+                redial = True  # silent peer: see docstring
+            except (ValueError, RPCError):
+                redial = True  # garbled/oversized reply: same hazard
+        finally:
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass
+        ok = (isinstance(resp, dict) and isinstance(resp.get("result"), dict)
+              and resp["result"].get("codec") == wire.WIRE_VERSION)
+        if ok:
+            metrics.inc("rpc.codec.negotiated_v2")
+            return BINARY_CODEC
+        metrics.inc("rpc.codec.fallback_v1")
+        if mode == "binary":
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise RPCError(f"peer {self._addr} does not speak wire v2")
+        if redial:
+            # one logical dial: the fault plane's @connect hook already
+            # ran for it, so the replacement socket is not re-hooked
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = self._dial()
+        return JSON_CODEC
+
+    @property
+    def codec_name(self) -> str:
+        """"json" (wire v1) or "binary" (wire v2) for this connection."""
+        return self._codec.name
 
     def _read_loop(self) -> None:
         try:
             while True:
-                resp = _read_frame(self._sock)
+                resp = _read_frame(self._sock, self._codec)
                 if not isinstance(resp, dict):
                     raise RPCError(f"non-object frame: {type(resp).__name__}")
                 with self._plock:
@@ -465,13 +677,14 @@ class RPCClient:
                     # partial frame + teardown: the reader fails every
                     # pending future (this one included) with a
                     # transport error, like a real mid-frame reset
-                    _write_truncated(self._sock, req, self._wlock)
+                    _write_truncated(self._sock, req, self._wlock,
+                                     self._codec)
                     self.close()
                     return fut
         try:
-            _write_frame(self._sock, req, self._wlock)
+            _write_frame(self._sock, req, self._wlock, self._codec)
             if duplicate:
-                _write_frame(self._sock, req, self._wlock)
+                _write_frame(self._sock, req, self._wlock, self._codec)
         except OSError as exc:
             with self._plock:
                 self._pending.pop(rid, None)

@@ -296,13 +296,28 @@ def make_tracer(
 
 
 def encode_token(token: Optional[Token]) -> Optional[str]:
-    """Tokens ride inside JSON RPC payloads as base64 strings."""
+    """Legacy (pre-wire-v2) form: tokens as base64 strings inside JSON
+    RPC payloads.  Kept because ``decode_token`` must keep accepting
+    frames from peers that still send this form."""
     if token is None:
         return None
     return base64.b64encode(bytes(token)).decode()
 
 
-def decode_token(s: Optional[str]) -> Optional[Token]:
+def wire_token(token: Optional[Token]) -> Optional[bytes]:
+    """Tokens ride RPC payloads as raw bytes: wire v2 ships them
+    verbatim; the JSON codec renders bytes as arrays of ints
+    (runtime/rpc.py ``_json_default``) — both of which
+    ``decode_token`` accepts alongside the legacy base64 string."""
+    return None if token is None else bytes(token)
+
+
+def decode_token(s) -> Optional[Token]:
+    """Accept every wire form a peer may send: ``None``, the legacy
+    base64 string (pre-v2 senders), a list of ints (wire v1 from a v2
+    sender), or raw bytes (wire v2)."""
     if s is None:
         return None
-    return base64.b64decode(s)
+    if isinstance(s, str):
+        return base64.b64decode(s)
+    return bytes(s)

@@ -5,8 +5,10 @@ fanned-out miss rounds (``CoordinatorConfig.SchedMaxInflight``).  A
 request arriving beyond the bound is REJECTED with
 :class:`AdmissionReject` instead of queueing without limit: the
 exception's ``retry_after_s`` hint travels in the RPC response frame as
-a dedicated ``retry_after`` field (runtime/rpc.py surfaces it as
-``RPCRetryAfter`` on the client), and powlib treats it as a
+a dedicated ``retry_after`` field — a JSON key on wire v1, a typed
+header flag + f64 on wire v2 (runtime/wire.py ``FLAG_RETRY_AFTER``;
+golden-vectored in tests/test_wire.py) — which runtime/rpc.py surfaces
+as ``RPCRetryAfter`` on the client, and powlib treats it as a
 *server-paced, non-counting* retry — backpressure never burns the
 client's transport-failure retry budget toward the terminal
 ``degraded:`` error (nodes/powlib.py).

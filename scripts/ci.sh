@@ -21,7 +21,11 @@
 # (scripts/sched_smoke.py, docs/SCHEDULER.md): K concurrent Mines on
 # one CPU worker must batch (mean occupancy > 1), coalesce duplicates,
 # and drain — ~30 s.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke]
+# `--wire-smoke` runs the deterministic RPC data-plane smoke
+# (scripts/wire_smoke.py, docs/RPC.md): wire-v2 negotiation, parallel
+# fan-out seams recorded, chaos on binary frames ridden out, and a
+# JSON-pinned client interoperating — ~20 s, pure CPU.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -53,6 +57,13 @@ if [ "${1:-}" = "--sched-smoke" ]; then
   echo "=== scheduler smoke (continuous batching, CPU platform) ==="
   JAX_PLATFORMS=cpu python scripts/sched_smoke.py
   echo "=== sched smoke OK ==="
+  exit 0
+fi
+
+if [ "${1:-}" = "--wire-smoke" ]; then
+  echo "=== wire smoke (codec negotiation + parallel fan-out + chaos-on-binary) ==="
+  JAX_PLATFORMS=cpu python scripts/wire_smoke.py
+  echo "=== wire smoke OK ==="
   exit 0
 fi
 

@@ -1,0 +1,21 @@
+"""Fixture: serial-rpc-fanout must fire on each blocking per-peer call
+inside a fan-out loop (3 findings)."""
+
+
+def broadcast(self, workers):
+    for w in workers:
+        w.client.call("WorkerRPCHandler.Found", {})  # finding 1
+
+
+def probe(refs):
+    dead = []
+    for ref in {id(r): r for r in refs}.values():
+        ref.client.call("WorkerRPCHandler.Ping", {}, timeout=2.0)  # finding 2
+        dead.append(ref)
+    return dead
+
+
+def nested(peer_groups):
+    for group in peer_groups:
+        for p in group:
+            p.call("X.Y", {})  # finding 3 (nested loop, same scope)

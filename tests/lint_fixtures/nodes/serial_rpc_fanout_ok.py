@@ -1,0 +1,37 @@
+"""Fixture: the sanctioned fan-out shapes must not trip
+serial-rpc-fanout."""
+
+import subprocess
+
+
+def parallel_fanout(workers):
+    # issue-then-await: the go() futures overlap, replies are collected
+    # under one shared deadline
+    futs = [w.client.go("WorkerRPCHandler.Mine", {}) for w in workers]
+    for fut in futs:
+        fut.result(timeout=10.0)
+
+
+def go_per_peer(workers):
+    for w in workers:
+        w.client.go("WorkerRPCHandler.Found", {})  # async issue is fine
+
+
+def call_outside_peer_loop(batches):
+    for batch in batches:  # not a peer collection
+        batch.client.call("CoordRPCHandler.Result", batch)
+
+
+def callback_defined_in_loop(workers):
+    fns = []
+    for w in workers:
+        # a nested function BODY is outside the loop's dynamic extent
+        def later(w=w):
+            return w.client.call("WorkerRPCHandler.Ping", {})
+        fns.append(later)
+    return fns
+
+
+def subprocess_is_not_rpc(worker_cmds):
+    for cmd in worker_cmds:
+        subprocess.call(cmd)

@@ -1,0 +1,35 @@
+"""Worker child for the SIGSTOP head-of-line test (tests/test_wire.py).
+
+Boots a real python-backend Worker on an ephemeral port, prints
+``WORKER_READY <addr>`` and serves until killed.  The parent freezes
+this whole process with SIGSTOP — TCP stays open, nothing answers — to
+prove the parallel fan-out (ISSUE 5) no longer lets one frozen worker
+add ``_call_timeout`` to fanout->first-result for the live workers.
+
+Usage: python tests/stopped_worker_child.py <coord_worker_api_addr>
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distpow_tpu.nodes.worker import Worker  # noqa: E402
+from distpow_tpu.runtime.config import WorkerConfig  # noqa: E402
+
+coord_addr = sys.argv[1]
+w = Worker(
+    WorkerConfig(
+        WorkerID="stopworker",
+        ListenAddr="127.0.0.1:0",
+        CoordAddr=coord_addr,
+        Backend="python",
+        WarmupNonceLens=[],
+        WarmupWidths=[],
+    )
+)
+addr = w.initialize_rpcs()
+w.start_forwarder()
+print(f"WORKER_READY {addr}", flush=True)
+threading.Event().wait()

@@ -215,6 +215,62 @@ def test_stage_order_production_before_diagnostics(bench):
     assert {"sha512", "sha384"} <= set(XLA_SERVING_COMPILE_IMPRACTICAL)
 
 
+CP = {
+    "delay_ms": 40.0, "rounds": 8, "ntz": 1,
+    "fanout": {"n8": {"serial": {"p50_ms": 300.0, "p95_ms": 376.6},
+                      "parallel": {"p50_ms": 8.0, "p95_ms": 10.4}}},
+    "cancel": {"n8": {"serial": {"p50_ms": 700.0, "p95_ms": 744.7},
+                      "parallel": {"p50_ms": 60.0, "p95_ms": 67.8}}},
+    "speedup": {"cancel_p95_n8": 10.98, "first_p95_n8": 36.12},
+    "codec": {"shrink": 4.12},
+}
+
+
+def test_finalize_attaches_control_plane_row(bench):
+    """The control-plane stage rides both artifacts of a normal run:
+    the stdout line (the driver's BENCH record) and provenance."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_FULL, 5.35e6, control_plane=CP
+    )
+    assert line["control_plane"] == CP
+    assert prov["control_plane"] == CP
+    assert line["unit"] == "MH/s"  # headline stays the kernel rate
+
+
+def test_finalize_control_plane_only_run(bench):
+    """bench.py --control-plane (or a device-unreachable round): the
+    line becomes the tunnel-independent perf row, and kernel provenance
+    is NOT re-stamped (prov None)."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None, control_plane=CP)
+    assert prov is None
+    assert line["unit"] == "ms"
+    assert line["value"] == 67.8  # cancel p95, 8 workers, parallel
+    assert line["vs_baseline"] == 10.98  # serial/parallel speedup
+    assert line["control_plane"] == CP
+
+
+def test_finalize_carries_forward_control_plane(bench):
+    """A later kernel-only run must not silently drop the provenance's
+    standing control-plane row."""
+    lm = dict(LAST_FULL, control_plane=CP)
+    line, prov = bench.finalize_record({"serving": 9800.0e6}, lm, 5.35e6)
+    assert prov["control_plane"] == CP
+    assert "control_plane" not in line  # not measured this run
+
+
+@pytest.mark.slow
+def test_control_plane_stage_meets_acceptance(bench):
+    """Live acceptance check (ISSUE 5): cancel fanout->last-ack p95 at
+    8 workers improves >= 3x over the serial baseline, binary frames
+    shrink the round's payload >= 2x, and a hung worker adds nothing
+    like the ack deadline to fanout->first-result."""
+    cp = bench.control_plane_stage(ns=(8,), rounds=6)
+    assert cp["speedup"]["cancel_p95_n8"] >= 3.0, cp["speedup"]
+    assert cp["codec"]["shrink"] >= 2.0, cp["codec"]
+    hung = cp["hung_worker"]
+    assert hung["first_p95_ms"] < hung["call_timeout_s"] * 1e3 / 2, hung
+
+
 def test_module_level_is_jax_free(bench):
     """The device-unreachable fast path must not import jax at module
     level (the probe runs in a subprocess; a hung backend would wedge

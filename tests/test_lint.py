@@ -105,6 +105,8 @@ CASES = [
      os.path.join("runtime", "silent_except_ok.py"), 3),
     ("bounded-queue", os.path.join("runtime", "bounded_queue_bad.py"),
      os.path.join("runtime", "bounded_queue_ok.py"), 4),
+    ("serial-rpc-fanout", os.path.join("nodes", "serial_rpc_fanout_bad.py"),
+     os.path.join("nodes", "serial_rpc_fanout_ok.py"), 3),
 ]
 
 

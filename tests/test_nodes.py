@@ -241,18 +241,56 @@ def test_reassign_worker_dies_mid_protocol():
 
 def test_reassign_hung_worker_detected():
     """A hung-but-connected worker (Mine RPC never returns) must be
-    detected via the bounded call timeout and its shard reassigned."""
-    s = Stack(2, failure_policy="reassign")
+    detected via the bounded ack timeout and its shard reassigned —
+    WITHOUT its timeout ever sitting on the round's critical path: the
+    parallel fan-out (ISSUE 5) harvests the hung ack off-path while the
+    live worker is already mining (difficulty 5 ~ 1M python candidates
+    keeps the round alive well past the 1 s ack deadline, so the
+    reassignment is observable)."""
+    s = Stack(2, failure_policy="reassign", failure_probe_secs=0.2)
     s.coordinator.handler._call_timeout = 1.0
     try:
         # worker2's Mine handler hangs forever (process alive, TCP open)
         s.workers[1].handler.Mine = lambda params: time.sleep(3600)
         client = s.new_client("client1")
-        res = mine_and_wait(client, b"\x67\x68", 2, timeout=30)
-        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        res = mine_and_wait(client, b"\x67\x68", 5, timeout=120)
+        assert puzzle.check_secret(res.nonce, res.secret, 5)
         mines = [a[2]["WorkerByte"] for a in s.sinks["coordinator"].actions()
                  if a[1] == "CoordinatorWorkerMine"]
         assert sorted(mines) == [0, 1, 1]
+    finally:
+        s.close()
+
+
+def test_hung_worker_does_not_block_round_start():
+    """Head-of-line proof (ISSUE 5 acceptance): with one fully hung
+    worker in the fan-out set, the live workers' round must start and
+    complete WITHOUT paying the hung worker's ack timeout — the serial
+    fan-out used to block `_call_timeout` before the round even began."""
+    s = Stack(3, failure_policy="reassign", failure_probe_secs=0.2)
+    try:
+        hang = lambda params: time.sleep(3600)  # noqa: E731
+        s.workers[2].handler.Mine = hang
+        s.workers[2].handler.Found = hang
+        s.workers[2].handler.Ping = hang
+        client = s.new_client("client1")
+        t0 = time.monotonic()
+        res = mine_and_wait(client, b"\x6c\x6d", 2, timeout=30)
+        elapsed = time.monotonic() - t0
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        # default reassign _call_timeout is 10 s; the serial baseline
+        # would spend >= one full timeout inside _assign_shards alone.
+        # The parallel path's only hung-worker cost is the SHARED Found
+        # deadline during the cancel storm, bounded by one timeout — but
+        # fanout->first-result must stay flat
+        assert elapsed < s.coordinator.handler._call_timeout + 5.0
+        evs = [e for e in __import__(
+            "distpow_tpu.runtime.telemetry", fromlist=["RECORDER"]
+        ).RECORDER.recent() if e["kind"] == "coord.first_result"]
+        assert evs, "no first-result event recorded"
+        assert evs[-1]["latency_s"] < 2.0, (
+            f"fanout->first-result head-of-line blocked: {evs[-1]}"
+        )
     finally:
         s.close()
 
@@ -801,6 +839,51 @@ def test_worker_restart_rejoins_service():
         assert any(a[1] == "WorkerMine"
                    for a in s.sinks["worker2b"].actions()), \
             "replacement worker never participated in fan-out"
+    finally:
+        s.close()
+
+
+def test_hung_worker_with_long_round_completes_after_reap():
+    """Review PR 5 regression (reproduced pre-fix): with one fully hung
+    worker and a round outliving the ~2 s ping timeout, _reap_dead
+    kills the worker (closing its client — which fails the pending
+    parallel Mine-ack future) and reassigns its shard; when
+    _harvest_inflight later resolves that failed future it must NOT
+    re-orphan the shard — the duplicate (worker, shard) task entry owed
+    the 2N-ack ledger acks the worker could never send, spinning the
+    drain loop forever and hanging the client's Mine RPC."""
+    from distpow_tpu.models import puzzle as pz
+
+    class SlowFinder:
+        """Holds the round open past the reap window, honoring cancel."""
+
+        def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
+            deadline = time.monotonic() + 6.0
+            while time.monotonic() < deadline:
+                if cancel_check and cancel_check():
+                    return None
+                time.sleep(0.1)
+            return pz.python_search(nonce, difficulty, thread_bytes)
+
+    s = Stack(3, failure_policy="reassign", failure_probe_secs=0.2)
+    # ack deadline AFTER the ping-based reap (~2.2 s): the reap must win
+    # the race so the harvest sees an already-reassigned shard
+    s.coordinator.handler._call_timeout = 4.0
+    try:
+        hang = lambda params: time.sleep(3600)  # noqa: E731
+        s.workers[2].handler.Mine = hang
+        s.workers[2].handler.Found = hang
+        s.workers[2].handler.Ping = hang
+        for w in s.workers[:2]:
+            w.handler.backend = SlowFinder()
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x6e\x6f", 2, timeout=60)
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        mines = [a[2]["WorkerByte"] for a in s.sinks["coordinator"].actions()
+                 if a[1] == "CoordinatorWorkerMine"]
+        # shard 2: the initial issue + exactly ONE reassignment — a
+        # second (harvest-driven) reissue is the ledger-corrupting bug
+        assert mines.count(2) == 2, mines
     finally:
         s.close()
 

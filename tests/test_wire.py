@@ -1,0 +1,468 @@
+"""Wire codec v2 + negotiation + parallel fan-out data-plane tests
+(ISSUE 5).
+
+Four layers:
+
+1. **Golden vectors** — exact bytes of representative frames in BOTH
+   directions (encode must reproduce them, decode must invert them),
+   including the typed ``retry_after`` response header.  The interning
+   tables in runtime/wire.py are append-only wire contract; an
+   accidental reorder fails here before it corrupts a mixed-version
+   cluster.
+2. **Negotiation** — auto clients speak v2 to v2 servers, fall back
+   transparently against JSON-only servers, and ``codec="binary"``
+   refuses a v1-only peer.
+3. **Mixed-version interop** — a JSON-pinned stack and a v2 stack run
+   the same Mine scenario and produce IDENTICAL per-node trace shapes;
+   payload bytes shrink >= 2x on the binary wire.
+4. **Chaos on binary** — the fault plane's truncate/duplicate mutations
+   behave on v2 frames exactly as on JSON (the client retry machinery
+   rides them out), and a SIGSTOP'd worker process no longer
+   head-of-line-blocks round start (slow tier).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from test_nodes import Stack, mine_and_wait  # noqa: E402
+
+from distpow_tpu.models import puzzle  # noqa: E402
+from distpow_tpu.runtime import faults, rpc, wire  # noqa: E402
+from distpow_tpu.runtime.metrics import REGISTRY  # noqa: E402
+from distpow_tpu.runtime.telemetry import RECORDER  # noqa: E402
+
+
+# -- 1. golden vectors -------------------------------------------------------
+
+MINE_REQ = {
+    "id": 1, "method": "WorkerRPCHandler.Mine",
+    "params": {"nonce": b"\x01\x02\x03\x04", "num_trailing_zeros": 2,
+               "worker_byte": 0, "worker_bits": 2,
+               "round": "0000000018f2a3b4c5d6e7f0",
+               "token": b"\x10\x11\x12\x13"},
+}
+MINE_REQ_HEX = (
+    "01018308068006040102030481030482030083030484051830303030303030303138"
+    "663261336234633564366537663085060410111213"
+)
+FOUND_REQ = {
+    "id": 2, "method": "WorkerRPCHandler.Found",
+    "params": {"nonce": b"\x01\x02\x03\x04", "num_trailing_zeros": 2,
+               "worker_byte": 3, "secret": b"\xaa\xbb",
+               "round": "0000000018f2a3b4c5d6e7f0",
+               "token": b"\x10\x11\x12\x13"},
+}
+FOUND_REQ_HEX = (
+    "010284080680060401020304810304820306860602aabb84051830303030303030"
+    "303138663261336234633564366537663085060410111213"
+)
+OK_RESP = {"id": 2, "result": {"worker_tasks": 1}, "error": None}
+OK_RESP_HEX = "0202000801880302"
+ERR_RESP = {"id": 3, "result": None, "error": "RuntimeError: boom"}
+ERR_RESP_HEX = "020301051252756e74696d654572726f723a20626f6f6d"
+RETRY_RESP = {
+    "id": 4, "result": None,
+    "error": "retry-after:0.500s coordinator run queue full (2/2)",
+    "retry_after": 0.5,
+}
+RETRY_RESP_HEX = (
+    "0204033fe0000000000000053372657472792d61667465723a302e3530307320636f"
+    "6f7264696e61746f722072756e2071756575652066756c6c2028322f3229"
+)
+NEG_REQ = {
+    "id": 5, "method": "rpc.custom",
+    "params": {"x": -3, "f": 1.5, "b": True, "n": None,
+               "l": [1, "s", b"\x00"]},
+}
+NEG_REQ_HEX = (
+    "0105000a7270632e637573746f6d08050001780305000166043ff8000000000000"
+    "0001620200016e0000016c07030302050173060100"
+)
+
+GOLDENS = [
+    ("mine-request", MINE_REQ, MINE_REQ_HEX),
+    ("found-request", FOUND_REQ, FOUND_REQ_HEX),
+    ("ok-response", OK_RESP, OK_RESP_HEX),
+    ("error-response", ERR_RESP, ERR_RESP_HEX),
+    ("retry-after-response", RETRY_RESP, RETRY_RESP_HEX),
+    ("uninterned-request", NEG_REQ, NEG_REQ_HEX),
+]
+
+
+@pytest.mark.parametrize("name,obj,hexpect", GOLDENS,
+                         ids=[g[0] for g in GOLDENS])
+def test_golden_vectors_both_directions(name, obj, hexpect):
+    encoded = wire.encode_frame(obj)
+    assert encoded.hex() == hexpect, (
+        f"{name}: encoding drifted — the interning tables are append-only "
+        f"wire contract (runtime/wire.py)"
+    )
+    decoded = wire.decode_frame(bytes.fromhex(hexpect))
+    # normalize: decode yields bytes for byte fields, identical otherwise
+    assert decoded == obj
+
+
+def test_retry_after_header_is_typed():
+    d = wire.decode_frame(bytes.fromhex(RETRY_RESP_HEX))
+    assert isinstance(d["retry_after"], float) and d["retry_after"] == 0.5
+    assert d["error"].startswith("retry-after:")
+    # and an ok frame never grows the key
+    assert "retry_after" not in wire.decode_frame(bytes.fromhex(OK_RESP_HEX))
+
+
+def test_roundtrip_stats_shaped_payload():
+    """Nested snapshot shapes (histogram dicts, None min/max, floats,
+    dotted non-interned keys) survive the codec unchanged."""
+    snap = {
+        "id": 9, "result": {
+            "counters": {"coord.mine_rpcs": 3, "rpc.codec.negotiated_v2": 2},
+            "gauges": {"search.hashes_per_s": 1.25e9},
+            "histograms": {"powlib.mine_s": {
+                "count": 2, "sum": 0.5, "min": None, "max": 0.4,
+                "buckets": [[0.0, 1], [0.42044820762685725, 1]],
+            }},
+            "role": "coordinator", "ok": True,
+        }, "error": None,
+    }
+    assert wire.decode_frame(wire.encode_frame(snap)) == snap
+
+
+def test_decoder_rejects_malformed_frames():
+    good = wire.encode_frame(MINE_REQ)
+    for bad in (
+        b"",                                # empty
+        b"\x09",                            # unknown frame kind
+        good[:-1],                          # truncated mid-value
+        good + b"\x00",                     # trailing garbage
+        b"\x01\x01\xff",                    # interned method id out of range
+        b"\x02\x01\x80",                    # unknown response flags
+        b"\x01\x01" + b"\x80" * 1,          # method ok but params missing
+    ):
+        with pytest.raises(ValueError):
+            wire.decode_frame(bad)
+
+
+def test_varint_and_int_edges():
+    for n in (0, 1, -1, 127, 128, -128, 2**31, -(2**31), 2**63 - 1,
+              -(2**63), 300000000000):
+        frame = wire.encode_frame({"id": 0, "result": n, "error": None})
+        assert wire.decode_frame(frame)["result"] == n
+
+
+# -- 2. negotiation ----------------------------------------------------------
+
+class _Echo:
+    def Ping(self, params):
+        return {"got": params}
+
+
+def _serve(negotiate=True):
+    srv = rpc.RPCServer(negotiate=negotiate)
+    srv.register("S", _Echo())
+    addr = srv.listen("127.0.0.1:0")
+    srv.serve_in_background()
+    return srv, addr
+
+
+def test_auto_negotiates_v2_and_roundtrips_bytes():
+    srv, addr = _serve()
+    try:
+        c = rpc.RPCClient(addr)
+        assert c.codec_name == "binary"
+        out = c.call("S.Ping", {"nonce": b"\xaa\xbb", "n": 5}, timeout=10)
+        # binary wire delivers bytes AS bytes, no int-list detour
+        assert out["got"]["nonce"] == b"\xaa\xbb" and out["got"]["n"] == 5
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_auto_falls_back_to_json_against_v1_only_server():
+    srv, addr = _serve(negotiate=False)
+    try:
+        before = REGISTRY.get("rpc.codec.fallback_v1")
+        c = rpc.RPCClient(addr)
+        assert c.codec_name == "json"
+        assert REGISTRY.get("rpc.codec.fallback_v1") == before + 1
+        out = c.call("S.Ping", {"nonce": b"\xaa"}, timeout=10)
+        # JSON wire renders bytes as the legacy int array
+        assert out["got"]["nonce"] == [170]
+        c.close()
+        with pytest.raises(rpc.RPCError):
+            rpc.RPCClient(addr, codec="binary")
+    finally:
+        srv.shutdown()
+
+
+def test_json_pinned_client_against_v2_server():
+    srv, addr = _serve()
+    try:
+        c = rpc.RPCClient(addr, codec="json")
+        assert c.codec_name == "json"
+        assert c.call("S.Ping", {"x": 1}, timeout=10)["got"]["x"] == 1
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+# -- 3. mixed-version interop over the full protocol -------------------------
+
+def _run_scenario(n_workers=1):
+    """One deterministic Mine scenario; returns per-node action-name
+    sequences plus the rpc.frame.sent_bytes delta of the PROTOCOL
+    frames (the byte window opens after every connection is dialed, so
+    the v2 stacks' one-off hello handshakes — which the JSON-pinned
+    stack never sends — don't dilute the Mine/Found comparison the
+    acceptance criterion is about)."""
+    s = Stack(n_workers)
+    try:
+        c = s.new_client("client1")
+        h0 = REGISTRY.get_histogram("rpc.frame.sent_bytes") or \
+            {"count": 0, "sum": 0.0}
+        r1 = mine_and_wait(c, b"\x77\x01", 2)
+        assert puzzle.check_secret(r1.nonce, r1.secret, 2)
+        mine_and_wait(c, b"\x77\x02", 2)
+        r2 = mine_and_wait(c, b"\x77\x01", 2)  # cache-hit repeat
+        assert r2.secret == r1.secret
+        h1 = REGISTRY.get_histogram("rpc.frame.sent_bytes")
+        shapes = {n: s.action_names(n)
+                  for n in ("client1", "coordinator", "worker1")}
+    finally:
+        s.close()
+    return shapes, h1["sum"] - h0["sum"]
+
+
+def test_mixed_version_trace_parity_and_payload_shrink(monkeypatch):
+    """A JSON-only cluster and a v2 cluster run the same rounds with
+    IDENTICAL trace shapes (the codec is invisible to the protocol),
+    and the binary wire carries the same rounds in <= half the bytes
+    (ISSUE 5 acceptance, asserted from rpc.frame.sent_bytes)."""
+    monkeypatch.setattr(rpc, "CLIENT_CODEC_DEFAULT", "json")
+    monkeypatch.setattr(rpc, "SERVER_NEGOTIATE_DEFAULT", False)
+    json_shapes, json_bytes = _run_scenario()
+
+    monkeypatch.setattr(rpc, "CLIENT_CODEC_DEFAULT", "auto")
+    monkeypatch.setattr(rpc, "SERVER_NEGOTIATE_DEFAULT", True)
+    v2_before = REGISTRY.get("rpc.codec.negotiated_v2")
+    bin_shapes, bin_bytes = _run_scenario()
+    assert REGISTRY.get("rpc.codec.negotiated_v2") > v2_before
+
+    assert bin_shapes == json_shapes, "codec changed the protocol's traces"
+    # aggregate: every frame of the measured rounds, both directions
+    # (measured 2.2x — the big raw-vs-base64 tracing tokens dilute the
+    # per-frame wins; deterministic for this 1-worker scenario)
+    assert json_bytes / bin_bytes >= 2.0, (
+        f"binary wire shrank payload only {json_bytes / bin_bytes:.2f}x "
+        f"({json_bytes:.0f} -> {bin_bytes:.0f} bytes)"
+    )
+
+
+def test_mine_found_frames_shrink_per_frame():
+    """The acceptance criterion's frame classes, compared exactly: a
+    representative Mine and Found frame each shrink >= 2.5x against the
+    JSON wire (base64 token form — the honest legacy baseline)."""
+    tok = bytes(range(40))
+    mine = {"id": 3, "method": "WorkerRPCHandler.Mine",
+            "params": {"nonce": b"\x01\x02\x03\x04", "num_trailing_zeros": 8,
+                       "worker_byte": 0, "worker_bits": 2,
+                       "round": "0" * 24, "token": tok}}
+    found = {"id": 4, "method": "WorkerRPCHandler.Found",
+             "params": {"nonce": b"\x01\x02\x03\x04", "num_trailing_zeros": 8,
+                        "worker_byte": 0, "secret": b"\xaa\xbb",
+                        "round": "0" * 24, "token": tok}}
+    for frame in (mine, found):
+        j = len(rpc.JSON_CODEC.encode(frame))
+        b = len(wire.encode_frame(frame))
+        assert j / b >= 2.5, f"{frame['method']}: {j}/{b} = {j / b:.2f}x"
+
+
+def test_binary_client_json_server_full_round(monkeypatch):
+    """Direction 1 of mixed-version: every CLIENT is v2-capable but
+    every SERVER is JSON-only — the hello degrades each connection to
+    v1 and a full Mine round completes."""
+    monkeypatch.setattr(rpc, "SERVER_NEGOTIATE_DEFAULT", False)
+    shapes, _ = _run_scenario()
+    assert shapes["coordinator"][-1] == "CoordinatorSuccess"
+
+
+def test_json_client_binary_server_full_round(monkeypatch):
+    """Direction 2: v1-pinned clients against v2-capable servers."""
+    monkeypatch.setattr(rpc, "CLIENT_CODEC_DEFAULT", "json")
+    shapes, _ = _run_scenario()
+    assert shapes["coordinator"][-1] == "CoordinatorSuccess"
+
+
+# -- 4. chaos on binary frames ----------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def test_fault_plane_mutations_on_binary_frames():
+    """truncate + duplicate on wire-v2 frames: the truncated Mine tears
+    the client connection (retry machinery re-dials and re-issues), the
+    duplicated Found re-dispatches idempotently — the chaos matrix
+    semantics are codec-independent."""
+    plan = faults.install_from_spec({"seed": 51, "rules": [
+        {"kind": "truncate", "method": "CoordRPCHandler.Mine",
+         "side": "client", "calls": "0:1", "max": 1},
+        {"kind": "duplicate", "method": "WorkerRPCHandler.Found",
+         "side": "client", "max": 1},
+    ]})
+    s = Stack(1)
+    try:
+        c = s.new_client("client1", MineRetries=4, MineBackoffS=0.05)
+        res = mine_and_wait(c, b"\x77\x42", 2, timeout=60)
+        assert res.error is None
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        kinds = {k for _, k, _, _, _ in plan.injected}
+        assert "truncate" in kinds, plan.injected
+        # the mined round really rode the binary wire
+        assert REGISTRY.get("rpc.codec.negotiated_v2") > 0
+    finally:
+        s.close()
+
+
+def test_trace_oracle_clean_over_parallel_fanout_golden_run(tmp_path):
+    """Trace-oracle pass over a parallel-fan-out run (ISSUE 5
+    satellite): a 4-worker stack under concurrent Mines — every fan-out
+    and cancel storm issued as parallel futures — must keep the
+    reference protocol's ordering invariants byte-for-byte checkable
+    (runtime/trace_check.py finds zero violations)."""
+    from distpow_tpu.runtime.config import TracingServerConfig
+    from distpow_tpu.runtime.trace_check import check_shiviz_log, check_trace_log
+    from distpow_tpu.runtime.trace_server import TracingServer
+    from distpow_tpu.runtime.tracing import TCPSink
+
+    out = tmp_path / "trace_output.log"
+    shiviz = tmp_path / "shiviz_output.log"
+    server = TracingServer(TracingServerConfig(
+        ServerBind="127.0.0.1:0", Secret=b"",
+        OutputFile=str(out), ShivizOutputFile=str(shiviz),
+    ))
+    addr = server.open()
+    server.accept_in_background()
+    s = Stack(4, sink_factory=lambda name: TCPSink(addr, b""))
+    try:
+        c1 = s.new_client("client1")
+        c2 = s.new_client("client2")
+        # overlapping requests: concurrent fan-outs + cancel storms
+        c1.mine(b"\x81\x01", 3)
+        c2.mine(b"\x81\x02", 3)
+        c1.mine(b"\x81\x03", 2)
+        for cl, n in ((c1, 2), (c2, 1)):
+            for _ in range(n):
+                r = cl.notify_queue.get(timeout=60)
+                assert r.error is None
+    finally:
+        s.close()
+        deadline = time.time() + 10
+        last = -1
+        while time.time() < deadline:
+            size = out.stat().st_size if out.exists() else 0
+            if size == last:
+                break
+            last = size
+            time.sleep(0.3)
+        server.close()
+    assert check_trace_log(str(out)) == []
+    assert check_shiviz_log(str(shiviz)) == []
+
+
+@pytest.mark.slow
+def test_sigstopped_worker_does_not_head_of_line_block(tmp_path):
+    """A worker PROCESS frozen with SIGSTOP (TCP open, nothing answers)
+    must not add `_call_timeout` to fanout->first-result for the live
+    workers (ISSUE 5 acceptance).  The serial fan-out blocked the whole
+    round start on the frozen worker's ack."""
+    from distpow_tpu.nodes import Coordinator, Worker
+    from distpow_tpu.runtime.config import CoordinatorConfig, WorkerConfig
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    coordinator = Coordinator(CoordinatorConfig(
+        ClientAPIListenAddr="127.0.0.1:0",
+        WorkerAPIListenAddr="127.0.0.1:0",
+        Workers=["pending:0"] * 3,
+        FailurePolicy="reassign",
+        FailureProbeSecs=0.2,
+    ))
+    client_addr, worker_api = coordinator.initialize_rpcs()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    child = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "tests", "stopped_worker_child.py"),
+         worker_api],
+        cwd=repo, env=env, stdout=subprocess.PIPE, text=True,
+    )
+    workers = []
+    try:
+        line = child.stdout.readline()
+        assert line.startswith("WORKER_READY "), line
+        child_addr = line.split()[1]
+        for i in range(2):
+            w = Worker(WorkerConfig(
+                WorkerID=f"live{i}", ListenAddr="127.0.0.1:0",
+                CoordAddr=worker_api, Backend="python",
+            ))
+            w.initialize_rpcs()
+            w.start_forwarder()
+            workers.append(w)
+        # child first: its shard 0 heads the fan-out order, the spot
+        # where serial dispatch paid the full head-of-line stall
+        coordinator.set_worker_addrs(
+            [child_addr] + [w.bound_addr for w in workers])
+
+        from distpow_tpu.nodes import Client
+        from distpow_tpu.runtime.config import ClientConfig
+        cl = Client(ClientConfig(ClientID="c", CoordAddr=client_addr))
+        cl.initialize()
+        try:
+            # round 1 healthy: establishes the child's connection
+            cl.mine(b"\x91\x01", 2)
+            assert cl.notify_queue.get(timeout=60).error is None
+
+            os.kill(child.pid, signal.SIGSTOP)
+            time.sleep(0.2)
+            t0 = time.monotonic()
+            cl.mine(b"\x91\x02", 2)
+            res = cl.notify_queue.get(timeout=60)
+            elapsed = time.monotonic() - t0
+            assert res.error is None
+            assert puzzle.check_secret(res.nonce, res.secret, 2)
+            call_timeout = coordinator.handler._call_timeout
+            evs = [e for e in RECORDER.recent()
+                   if e["kind"] == "coord.first_result"]
+            assert evs and evs[-1]["latency_s"] < 2.0, (
+                f"frozen worker head-of-line-blocked round start "
+                f"(call_timeout={call_timeout}): {evs[-1:]}"
+            )
+            # end-to-end bounded by ~one shared Found deadline, never
+            # one timeout per worker
+            assert elapsed < call_timeout + 5.0
+        finally:
+            cl.close()
+    finally:
+        try:
+            os.kill(child.pid, signal.SIGCONT)
+        except ProcessLookupError:
+            pass
+        child.terminate()
+        try:
+            child.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            child.kill()
+        for w in workers:
+            w.shutdown()
+        coordinator.shutdown()
