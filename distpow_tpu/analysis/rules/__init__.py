@@ -10,6 +10,7 @@ from . import (
     dead_package,
     hot_path_host_sync,
     metrics_registry,
+    relaunch_loop_sync,
     serial_rpc_fanout,
     silent_except,
     trace_vocabulary,
@@ -23,6 +24,7 @@ ALL_RULES = (
     metrics_registry,
     config_key_sync,
     hot_path_host_sync,
+    relaunch_loop_sync,
     silent_except,
     dead_package,
 )

@@ -1,0 +1,93 @@
+"""relaunch-loop-sync — no blocking result conversions in dispatch loops.
+
+The persistent serving loop (docs/SERVING.md) exists because the old
+launch/fetch/relaunch loop paid one BLOCKING host sync per launch: an
+``int(res)`` on an in-flight device value inside the dispatch loop
+stalls the host until the device drains, serializing the pipeline and
+putting the host round trip back on the critical path — the exact
+regression BENCH_r05 measured as a 30-60x serving gap on the slower
+hashes.  The sanctioned patterns are (a) the solo drivers' dedicated
+drain helpers (``drain_one`` — a conversion OUTSIDE any dispatch loop,
+and in the persistent driver one that polls ``is_ready()`` first) and
+(b) the scheduler's single ``jax.device_get`` per batched launch.
+
+This rule flags ``int(<name>)`` / ``int(<name>[...])`` calls that sit
+lexically inside a ``for``/``while`` loop (or a comprehension) in the
+driver and scheduler packages — the shape every relaunch-loop sync in
+this repo's history has taken.  A conversion that is genuinely
+host-side (an already-fetched array) is suppressed with the
+justification inline; anything else should drain through the FIFO or
+poll readiness first.
+
+Scope: ``distpow_tpu/parallel/`` and ``distpow_tpu/sched/``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ._util import in_dirs
+
+RULE_ID = "relaunch-loop-sync"
+DESCRIPTION = (
+    "no blocking int(<device value>) conversions inside dispatch loops "
+    "in parallel/ or sched/ — drain through the FIFO or poll is_ready()"
+)
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While, ast.ListComp, ast.SetComp,
+          ast.DictComp, ast.GeneratorExp)
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _in_scope(path: str) -> bool:
+    return in_dirs(path, "parallel", "sched")
+
+
+def _flaggable_arg(node: ast.Call) -> bool:
+    """``int(name)`` or ``int(name[...])`` — the conversion shapes a
+    device value takes in this codebase.  Calls, attributes and
+    constants as the argument are host-side arithmetic, not syncs."""
+    if len(node.args) != 1 or node.keywords:
+        return False
+    arg = node.args[0]
+    if isinstance(arg, ast.Name):
+        return True
+    return isinstance(arg, ast.Subscript) and \
+        isinstance(arg.value, ast.Name)
+
+
+def _int_calls_in_loops(root: ast.AST) -> Iterator[ast.Call]:
+    """Yield flaggable int() calls lexically inside a loop, without
+    crossing into nested function/lambda bodies (those run outside the
+    loop's dynamic extent — e.g. a drain helper *defined* near a loop
+    but called once per launch boundary)."""
+    stack = [(child, False) for child in ast.iter_child_nodes(root)]
+    while stack:
+        node, in_loop = stack.pop()
+        if isinstance(node, ast.Call) and in_loop and \
+                isinstance(node.func, ast.Name) and node.func.id == "int" \
+                and _flaggable_arg(node):
+            yield node
+        entered = in_loop or isinstance(node, _LOOPS)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPES):
+                # nested scope: restart the loop tracking inside it
+                stack.extend(
+                    (c, False) for c in ast.iter_child_nodes(child)
+                )
+            else:
+                stack.append((child, entered))
+
+
+def check(module, context) -> Iterator:
+    if not _in_scope(module.path):
+        return
+    for node in _int_calls_in_loops(module.tree):
+        yield module.finding(
+            RULE_ID, node,
+            "int() on a (potential) device value inside a dispatch loop "
+            "blocks the host per launch and serializes the pipeline — "
+            "drain through the driver's FIFO / poll is_ready() first, "
+            "or suppress with why this conversion cannot block",
+        )

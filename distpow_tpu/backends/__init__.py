@@ -128,17 +128,64 @@ def _warm_layouts(build, nonce_lens, widths, batch_size, tbc=256,
 
 
 class JaxBackend:
-    """Single-device fused-step search (the TPU path)."""
+    """Single-device fused-step search (the TPU path).
+
+    ``loop`` selects the serving loop (docs/SERVING.md):
+    ``"persistent"`` (default) drives the multi-segment on-device loop
+    with the polling drain (parallel/search.py persistent_search);
+    ``"serial"`` keeps the pre-PR-6 launch/fetch/relaunch loop — the
+    bench baseline (``bench.py --serving-loop``) and the escape hatch.
+    """
 
     name = "jax"
 
     def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20,
-                 max_launch: Optional[int] = None, **_):
+                 max_launch: Optional[int] = None,
+                 loop: str = "persistent", **_):
         from ..models.registry import get_hash_model
 
         self.model = get_hash_model(hash_model)
         self.batch_size = batch_size
         self.max_launch = _resolve_max_launch(max_launch, self.model)
+        if loop not in ("persistent", "serial"):
+            raise ValueError(
+                f"unknown search loop {loop!r}: expected 'persistent' "
+                f"or 'serial'"
+            )
+        self.loop = loop
+
+    def _persistent_warm_factory(self, nonce: bytes, tbc: int,
+                                 difficulty: int):
+        """StepFactory-shaped builder over the persistent step, so the
+        shared ``_warm_layouts`` derivation (same target/k/mask-bucket
+        keys as serving) warms the persistent programs too.  The warmup
+        dispatch carries a SET stop flag: the on-device loop exits at
+        its first condition check, so warming compiles the real program
+        at near-zero device cost."""
+        import jax.numpy as jnp
+
+        from ..ops.search_step import (
+            cached_persistent_step,
+            cached_search_step,
+        )
+
+        stop_set = jnp.uint32(1)
+        model_name = self.model.name
+
+        def factory(vw, extra, target_chunks, launch_steps=1):
+            if vw == 0:
+                step = cached_search_step(
+                    nonce, 0, difficulty, 0, tbc, 1, model_name, extra, 1
+                )
+                return step, 1
+            bound = cached_persistent_step(
+                nonce, vw, difficulty, 0, tbc, target_chunks, model_name,
+                extra, launch_steps,
+            )
+            return (lambda chunk0: bound(chunk0, stop_set)[0]), \
+                target_chunks * launch_steps
+
+        return factory
 
     def warmup(self, nonce_lens: Sequence[int], widths: Sequence[int]) -> None:
         """Pre-compile the layout-keyed programs these nonce lengths hit.
@@ -148,18 +195,27 @@ class JaxBackend:
         length and the full 256-byte partition covers every future nonce
         of that length at any difficulty (one program per mask-word
         bucket, WARMUP_DIFFICULTIES) and any power-of-two partition.
+        The warmed programs follow the configured loop: the persistent
+        step's compile keys differ from the relaunch step's.
         """
         from ..parallel.search import default_step_factory
 
+        if self.loop == "persistent":
+            build = self._persistent_warm_factory
+        else:
+            def build(nonce, tbc, d):
+                return default_step_factory(nonce, d, 0, tbc, self.model)
+
         _warm_layouts(
-            lambda nonce, tbc, d: default_step_factory(nonce, d, 0, tbc, self.model),
+            build,
             nonce_lens, widths, self.batch_size, max_launch=self.max_launch,
         )
 
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
-        from ..parallel.search import search
+        from ..parallel.search import persistent_search, search
 
-        res = search(
+        drive = persistent_search if self.loop == "persistent" else search
+        res = drive(
             nonce,
             difficulty,
             thread_bytes,

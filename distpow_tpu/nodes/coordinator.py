@@ -995,7 +995,14 @@ class CoordRPCHandler:
                     secret=bytes(params["secret"]),
                 )
             )
-            self.result_cache.add(nonce, ntz, bytes(params["secret"]), trace)
+            if not params.get("hash_model"):
+                # the dominance cache is single-model: an off-default
+                # result (tagged by the worker, docs/SERVING.md) must
+                # never be installed where a default-model lookup could
+                # replay it — same invariant the worker's Found handler
+                # enforces one hop down
+                self.result_cache.add(nonce, ntz, bytes(params["secret"]),
+                                      trace)
         entry = self._task_get((nonce, ntz))
         if entry is None:
             # documented fix: the reference blocks forever on a nil channel

@@ -86,6 +86,15 @@ def _key(params) -> TaskKey:
             int(params["worker_byte"]))
 
 
+def _backend_model_name(backend) -> str:
+    """The hash model a backend was built to serve (every backend
+    carries either a ``HashModel`` or its name)."""
+    m = getattr(backend, "model", None)
+    if m is not None:
+        return m.name
+    return getattr(backend, "hash_model", "md5")
+
+
 def _rid_order(rid: str) -> str:
     """Round-id ordering key, robust to the id-format width change.
 
@@ -217,9 +226,48 @@ class WorkerRPCHandler:
             return self._tasks.get(key)
 
     # -- RPCs ---------------------------------------------------------------
+    def _default_model(self) -> str:
+        return (self.scheduler.model.name if self.scheduler is not None
+                else _backend_model_name(self.backend))
+
     def Mine(self, params) -> dict:
         metrics.inc("worker.mine_rpcs")
         key = _key(params)
+        # optional per-request hash model (docs/SERVING.md mixed-hash
+        # serving): requests off the worker's default model need the
+        # batching scheduler's registry dispatch — without it the
+        # single-model backend cannot honor the request, and failing
+        # the RPC here (before the task registers) is the honest reply
+        hash_model = params.get("hash_model") or None
+        if hash_model is not None and hash_model != self._default_model():
+            if self.scheduler is None:
+                raise RuntimeError(
+                    f"worker serves {self._default_model()!r} and has no "
+                    f"batching scheduler for mixed-hash requests "
+                    f"(got hash_model={hash_model!r})"
+                )
+            # validate the model HERE, not in the miner thread: an
+            # unknown name (or a never-admitted model, engine._solo)
+            # raising inside the daemon thread would produce no result,
+            # no acks and no error reply — the caller would wait out
+            # its full timeout instead of getting this honest refusal.
+            # Lazy imports: registry pulls jax, which a scheduler
+            # worker has necessarily already loaded.
+            from ..models.registry import get_hash_model
+            from ..ops.search_step import XLA_SERVING_COMPILE_IMPRACTICAL
+            try:
+                model = get_hash_model(hash_model)
+            except (KeyError, ValueError) as exc:
+                raise RuntimeError(
+                    f"unknown hash_model {hash_model!r}"
+                ) from exc
+            if model.name in XLA_SERVING_COMPILE_IMPRACTICAL:
+                raise RuntimeError(
+                    f"hash_model {model.name!r} is never admitted to the "
+                    f"XLA serving path (XLA_SERVING_COMPILE_IMPRACTICAL): "
+                    f"serve it from a worker whose configured backend is "
+                    f"its Pallas kernel"
+                )
         round_ = TaskRound(params.get("round"))
         self._task_set(key, round_)
 
@@ -231,7 +279,8 @@ class WorkerRPCHandler:
         )
         threading.Thread(
             target=self._mine,
-            args=(key, int(params["worker_bits"]), round_, trace),
+            args=(key, int(params["worker_bits"]), round_, trace,
+                  hash_model),
             daemon=True,
         ).start()
         return {}
@@ -241,9 +290,16 @@ class WorkerRPCHandler:
         key = _key(params)
         secret = bytes(params["secret"])
         trace = self.tracer.receive_token(decode_token(params["token"]))
+        # the dominance cache is single-model (entries satisfy lookups
+        # purely by (nonce, ntz)): a secret solving under an off-default
+        # hash must never be installed where a default-model lookup
+        # could replay it (docs/SERVING.md)
+        cacheable = (params.get("hash_model") or None) in (
+            None, self._default_model())
         round_ = self._task_take(key, params.get("round"))
         if round_ is not None:
-            self.result_cache.add(key[0], key[1], secret, trace)
+            if cacheable:
+                self.result_cache.add(key[0], key[1], secret, trace)
             round_.ev.set()
         else:
             # no active task for this round: cache-update-only round
@@ -253,7 +309,8 @@ class WorkerRPCHandler:
                     nonce=key[0], num_trailing_zeros=key[1], worker_byte=key[2]
                 )
             )
-            self.result_cache.add(key[0], key[1], secret, trace)
+            if cacheable:
+                self.result_cache.add(key[0], key[1], secret, trace)
             self._send_result(key, None, trace, params.get("round"))
         return {}
 
@@ -286,27 +343,33 @@ class WorkerRPCHandler:
 
     # -- miner (worker.go:258-401) -----------------------------------------
     def _send_result(self, key: TaskKey, secret: Optional[bytes], trace,
-                     round_id=None) -> None:
+                     round_id=None, hash_model: Optional[str] = None) -> None:
         metrics.inc("worker.results_sent")
-        self.result_queue.put(
-            {
-                # bytes fields travel raw: wire v2 ships them verbatim,
-                # the JSON codec renders them as the int arrays every
-                # earlier version sent (runtime/rpc.py _json_default)
-                "nonce": bytes(key[0]),
-                "num_trailing_zeros": key[1],
-                "worker_byte": key[2],
-                "secret": bytes(secret) if secret is not None else None,
-                "round": round_id,
-                "token": wire_token(trace.generate_token()),
-            }
-        )
+        msg = {
+            # bytes fields travel raw: wire v2 ships them verbatim,
+            # the JSON codec renders them as the int arrays every
+            # earlier version sent (runtime/rpc.py _json_default)
+            "nonce": bytes(key[0]),
+            "num_trailing_zeros": key[1],
+            "worker_byte": key[2],
+            "secret": bytes(secret) if secret is not None else None,
+            "round": round_id,
+            "token": wire_token(trace.generate_token()),
+        }
+        if hash_model is not None:
+            # off-default-model result (docs/SERVING.md): tagged so the
+            # coordinator's single-model dominance cache skips it — a
+            # replayed off-model secret would fail default-model checks.
+            # Absent for default-model results, keeping those frames
+            # wire-identical to every earlier version on both codecs.
+            msg["hash_model"] = hash_model
+        self.result_queue.put(msg)
         # forwarder backlog: grows when the coordinator is slow/away
         # (qsize is advisory under concurrency — a gauge, not a ledger)
         metrics.gauge("worker.forward_queue_depth", self.result_queue.qsize())
 
     def _finish_found(self, key: TaskKey, secret: bytes, round_: TaskRound,
-                      trace) -> None:
+                      trace, hash_model: Optional[str] = None) -> None:
         """Result -> block for Found -> WorkerCancel -> nil ACK ordering."""
         trace.record_action(
             act.WorkerResult(
@@ -314,7 +377,8 @@ class WorkerRPCHandler:
                 worker_byte=key[2], secret=secret,
             )
         )
-        self._send_result(key, secret, trace, round_.round_id)
+        self._send_result(key, secret, trace, round_.round_id,
+                          hash_model=hash_model)
         round_.ev.wait()  # coordinator always sends Found (worker.go:375-379)
         if round_.superseded:
             # replaced by a newer Mine for this key while waiting: the
@@ -328,10 +392,16 @@ class WorkerRPCHandler:
         self._send_result(key, None, trace, round_.round_id)
 
     def _mine(self, key: TaskKey, worker_bits: int, round_: TaskRound,
-              trace) -> None:
+              trace, hash_model=None) -> None:
         nonce, ntz, worker_byte = key
         t0 = time.monotonic()
-        cached = self.result_cache.get(nonce, ntz, trace)
+        # mixed-hash requests bypass the (single-model) dominance cache
+        # entirely: its entries solve under the DEFAULT model, and a
+        # replayed default-model secret would fail the requested hash
+        off_model = (hash_model is not None
+                     and hash_model != self._default_model())
+        cached = None if off_model else self.result_cache.get(
+            nonce, ntz, trace)
         if cached is not None:
             self._finish_found(key, cached, round_, trace)
             return
@@ -343,17 +413,22 @@ class WorkerRPCHandler:
             # coordinator abandoned must not burn the device forever.
             # satisfies() is the unmetered lookup: this polls every batch
             # and must not pollute the cache.hit/miss protocol counters
-            return (round_.ev.is_set()
-                    or self.result_cache.satisfies(nonce, ntz) is not None)
+            if round_.ev.is_set():
+                return True
+            return (not off_model
+                    and self.result_cache.satisfies(nonce, ntz) is not None)
 
         tbs = partition.thread_bytes(worker_byte, worker_bits)
         if self.scheduler is not None:
             # scheduler path: this thread only parks on the slot's
             # completion — the engine's single loop owns the device, so
             # the active_searches pile-up the contention stress test
-            # recorded cannot form (docs/SCHEDULER.md)
+            # recorded cannot form (docs/SCHEDULER.md).  Mixed-hash
+            # requests ride the same slot table: the engine packs
+            # per-model sub-batches into one launch (docs/SERVING.md)
             secret = self.scheduler.search(
-                nonce, ntz, tbs, cancel_check=cancel_check
+                nonce, ntz, tbs, cancel_check=cancel_check,
+                hash_model=hash_model,
             )
         else:
             self._searches_delta(+1)
@@ -371,7 +446,8 @@ class WorkerRPCHandler:
             # a REAL device solve (cache replays return above): this is
             # the worker-side latency distribution of the paper's race
             metrics.observe("worker.solve_s", time.monotonic() - t0)
-            self._finish_found(key, secret, round_, trace)
+            self._finish_found(key, secret, round_, trace,
+                               hash_model=hash_model if off_model else None)
             return
         if round_.ev.is_set():
             # cancelled by a Found/Cancel RPC: Mine receipt -> honored
@@ -379,7 +455,8 @@ class WorkerRPCHandler:
             metrics.observe("worker.time_to_cancel_s",
                             time.monotonic() - t0)
         else:
-            cached = self.result_cache.get(nonce, ntz, None)
+            cached = None if off_model else self.result_cache.get(
+                nonce, ntz, None)
             if cached is not None:
                 # cache-triggered stop: deliver the cached secret as this
                 # task's result so the owning request's protocol still
@@ -444,6 +521,7 @@ class Worker:
             mesh_devices=config.MeshDevices,
             max_launch=config.MaxLaunchCandidates or None,
             interpret=getattr(config, "PallasInterpret", False),
+            loop=getattr(config, "SearchLoop", "persistent") or "persistent",
         )
         self.scheduler = None
         if (getattr(config, "Scheduler", "off") or "off") == "batching":
@@ -457,6 +535,8 @@ class Worker:
                 batch_size=config.BatchSize,
                 max_slots=getattr(config, "SchedMaxSlots", 8) or 8,
                 fallback=backend,
+                extra_models=tuple(
+                    getattr(config, "SchedHashModels", ()) or ()),
             )
         self.handler = WorkerRPCHandler(
             self.tracer, self.result_queue, backend,

@@ -363,6 +363,171 @@ def flat_to_candidate(
 
 
 @functools.lru_cache(maxsize=None)
+def persistent_search_step(
+    model_name: str,
+    n_blocks: int,
+    tb_loc,
+    chunk_locs,
+    batch: int,
+    static_tbc,  # None => power-of-two partition passed as log2 operand
+    segments: int,
+    mask_words: int = 0,
+):
+    """Persistent-loop serving step: a multi-segment on-device search
+    (docs/SERVING.md).
+
+    Where the ``fori_loop`` steps above run every sub-batch
+    unconditionally, this step carries a device-resident found
+    flag/result buffer through a ``while_loop``: each iteration
+    evaluates one ``batch``-candidate sub-batch, folds its first hit
+    into the carried best index, and the loop EXITS as soon as the
+    carry holds a hit (or the host-writable ``stop`` operand is
+    nonzero).  One dispatch therefore covers up to ``segments``
+    sub-batches of device work with zero host round trips between them,
+    a hit surfaces without paying for the launch's remaining segments,
+    and a dispatch issued after the host flips its stop flag costs one
+    loop-condition check.
+
+    Signature of the returned jitted fn (all uint32):
+    ``(init[S], base[n_blocks, W], masks[D], tb_lo, log_tbc_or_nothing,
+    chunk0, stop) -> uint32[2]`` — ``[0]`` is the first-hit flat index
+    over the full ``segments * batch`` span (reference enumeration
+    order; segments scan in order and each segment folds its own
+    minimum) or SENTINEL, ``[1]`` is the number of segments actually
+    executed (the driver's evaluated-work accounting, and the
+    ``search.persistent_steps`` instrument).
+    """
+    model = get_hash_model(model_name)
+    _check_launch(batch, segments)
+    mw = mask_words or model.digest_words
+
+    def sub(init, base, masks, tb_lo, log_tbc, chunk0, f):
+        if static_tbc is None:
+            chunk = jnp.uint32(chunk0) + (f >> log_tbc)
+            tb = tb_lo + (f & ((jnp.uint32(1) << log_tbc) - jnp.uint32(1)))
+        else:
+            chunk = jnp.uint32(chunk0) + f // jnp.uint32(static_tbc)
+            tb = tb_lo + f % jnp.uint32(static_tbc)
+        state = eval_dyn_candidates(
+            model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
+        )
+        hit = fold_dyn_masks(model, state, masks, mw)
+        return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
+
+    def make_step(take_log_tbc: bool):
+        def step(init, base, masks, tb_lo, log_tbc, chunk0, stop):
+            f0 = jnp.arange(batch, dtype=jnp.uint32)
+
+            def cond(state):
+                seg, best = state
+                return (
+                    (seg < jnp.uint32(segments))
+                    & (best == jnp.uint32(SENTINEL))
+                    & (stop == jnp.uint32(0))
+                )
+
+            def body(state):
+                seg, best = state
+                f = seg * jnp.uint32(batch) + f0
+                found = sub(init, base, masks, tb_lo, log_tbc, chunk0, f)
+                return seg + jnp.uint32(1), jnp.minimum(best, found)
+
+            seg, best = jax.lax.while_loop(
+                cond, body, (jnp.uint32(0), jnp.uint32(SENTINEL))
+            )
+            return jnp.stack([best, seg])
+
+        if take_log_tbc:
+            return step
+
+        def step_static(init, base, masks, tb_lo, chunk0, stop):
+            return step(init, base, masks, tb_lo, jnp.uint32(0), chunk0, stop)
+
+        return step_static
+
+    return jax.jit(make_step(static_tbc is None))
+
+
+@functools.lru_cache(maxsize=512)
+def cached_persistent_step(
+    nonce: bytes,
+    width: int,
+    difficulty: int,
+    tb_lo: int,
+    tb_count: int,
+    chunks_per_step: int,
+    model_name: str,
+    extra_const_chunk: bytes = b"",
+    segments: int = 1,
+):
+    """Serving-path persistent step: binds request operands onto the
+    layout-keyed multi-segment program, exactly as ``cached_search_step``
+    binds the relaunch-loop program.  Returns ``bound(chunk0, stop) ->
+    uint32[2]`` covering up to ``segments * chunks_per_step * tb_count``
+    candidates per dispatch (early-exit on hit or stop).  Width 0 has a
+    single 256-candidate probe and no chunk axis — the driver serves it
+    through ``cached_search_step`` instead.
+    """
+    if width == 0:
+        raise ValueError(
+            "width 0 has no persistent form; use cached_search_step"
+        )
+    model = get_hash_model(model_name)
+    spec = build_tail_spec(bytes(nonce), width, model, extra_const_chunk)
+    init, base, masks = step_operands(spec, difficulty, model)
+    mw = mask_words_for(difficulty, model)
+    tb_lo_op = jnp.uint32(tb_lo)
+    batch = chunks_per_step * tb_count
+    pow2 = tb_count & (tb_count - 1) == 0
+    dyn = persistent_search_step(
+        model_name, spec.n_blocks, spec.tb_loc, spec.chunk_locs, batch,
+        None if pow2 else tb_count, segments, mw,
+    )
+    if pow2:
+        log_tbc = jnp.uint32(tb_count.bit_length() - 1)
+
+        def bound(chunk0, stop):
+            return dyn(init, base, masks, tb_lo_op, log_tbc, chunk0, stop)
+
+    else:
+
+        def bound(chunk0, stop):
+            return dyn(init, base, masks, tb_lo_op, chunk0, stop)
+
+    return bound
+
+
+def _slot_lane(model: HashModel, n_blocks: int, tb_loc, chunk_locs,
+               batch: int, launch_steps: int):
+    """One slot's un-vmapped search lane — the shared core of the
+    single-model ``slot_search_step`` and the mixed-hash
+    ``mixed_slot_search_step`` (each vmaps it over its own slot axis)."""
+
+    def one(init, base, masks, tb_lo, log_tbc, chunk0):
+        def sub(f):
+            chunk = jnp.uint32(chunk0) + (f >> log_tbc)
+            tb = tb_lo + (f & ((jnp.uint32(1) << log_tbc) - jnp.uint32(1)))
+            state = eval_dyn_candidates(
+                model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
+            )
+            hit = fold_dyn_masks(model, state, masks)
+            return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
+
+        f0 = jnp.arange(batch, dtype=jnp.uint32)
+        if launch_steps == 1:
+            return sub(f0)
+
+        def body(i, best):
+            return jnp.minimum(
+                best, sub(i.astype(jnp.uint32) * jnp.uint32(batch) + f0)
+            )
+
+        return jax.lax.fori_loop(0, launch_steps, body, jnp.uint32(SENTINEL))
+
+    return one
+
+
+@functools.lru_cache(maxsize=None)
 def slot_search_step(
     model_name: str,
     n_blocks: int,
@@ -401,26 +566,46 @@ def slot_search_step(
     """
     model = get_hash_model(model_name)
     _check_launch(batch, launch_steps)
+    return jax.jit(jax.vmap(
+        _slot_lane(model, n_blocks, tb_loc, chunk_locs, batch, launch_steps)
+    ))
 
-    def one(init, base, masks, tb_lo, log_tbc, chunk0):
-        def sub(f):
-            chunk = jnp.uint32(chunk0) + (f >> log_tbc)
-            tb = tb_lo + (f & ((jnp.uint32(1) << log_tbc) - jnp.uint32(1)))
-            state = eval_dyn_candidates(
-                model, n_blocks, tb_loc, chunk_locs, init, base, tb, chunk
-            )
-            hit = fold_dyn_masks(model, state, masks)
-            return jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
 
-        f0 = jnp.arange(batch, dtype=jnp.uint32)
-        if launch_steps == 1:
-            return sub(f0)
+@functools.lru_cache(maxsize=None)
+def mixed_slot_search_step(
+    groups: tuple,
+    batch: int,
+    launch_steps: int = 1,
+):
+    """Mixed-hash multi-slot step: slots of DIFFERENT hash models share
+    one device dispatch (docs/SERVING.md).
 
-        def body(i, best):
-            return jnp.minimum(
-                best, sub(i.astype(jnp.uint32) * jnp.uint32(batch) + f0)
-            )
+    ``groups`` is an ordered tuple of per-model sub-batch descriptors
+    ``(model_name, n_blocks, tb_loc, chunk_locs, n_slots)`` — the
+    compile key is therefore the full MODEL SET of the launch (plus
+    each group's padded lane count), extending the single-model step's
+    layout key exactly the way the scheduler's launch planner groups
+    its slot table.  Inside the one compiled program each group runs
+    its own vmapped lane stack (per-model compress functions cannot
+    share lanes — different round structures — but they CAN share a
+    launch, which is what restores batching to mixed-hash traffic that
+    previously forfeited it to the solo fallback).
 
-        return jax.lax.fori_loop(0, launch_steps, body, jnp.uint32(SENTINEL))
+    The returned jitted fn takes a tuple of per-group operand tuples
+    ``((init[n_i, S_i], base[n_i, b_i, W_i], masks[n_i, D_i],
+    tb_lo[n_i], log_tbc[n_i], chunk0[n_i]), ...)`` and returns a tuple
+    of per-group ``uint32[n_i]`` first-hit vectors, all fetched in one
+    host<->device round trip.
+    """
+    lanes = tuple(
+        _slot_lane(get_hash_model(m), nb, tl, cl, batch, launch_steps)
+        for (m, nb, tl, cl, _n) in groups
+    )
+    _check_launch(batch, launch_steps)
 
-    return jax.jit(jax.vmap(one))
+    def step(group_ops):
+        return tuple(
+            jax.vmap(lane)(*ops) for lane, ops in zip(lanes, group_ops)
+        )
+
+    return jax.jit(step)

@@ -32,7 +32,8 @@ from .partition import (  # noqa: F401
 __all__ = [
     "contiguous_bounds", "remainder_bits", "split_thread_bytes",
     "thread_bytes", "worker_bits",
-    "SearchResult", "search", "make_mesh", "search_mesh",
+    "SearchResult", "search", "persistent_search", "make_mesh",
+    "search_mesh",
 ]
 
 
@@ -62,6 +63,7 @@ def _lazy(submodule: str, name: str) -> property:
 class _ParallelModule(types.ModuleType):
     SearchResult = _lazy("search", "SearchResult")
     search = _lazy("search", "search")
+    persistent_search = _lazy("search", "persistent_search")
     make_mesh = _lazy("mesh_search", "make_mesh")
     search_mesh = _lazy("mesh_search", "search_mesh")
 

@@ -42,7 +42,11 @@ from typing import Callable, Optional, Sequence, Tuple
 
 from ..models import puzzle
 from ..models.registry import HashModel, get_hash_model
-from ..ops.search_step import SENTINEL, cached_search_step
+from ..ops.search_step import (
+    SENTINEL,
+    cached_persistent_step,
+    cached_search_step,
+)
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.watchdog import FIRST_COMPILE_GRACE_S, WATCHDOG
 
@@ -221,6 +225,36 @@ def width_segments(width: int):
         yield 4, 0, 1 << 32, hi.to_bytes(hi_w, "little")
 
 
+def _unsatisfiable_wait(model: HashModel, difficulty: int, cancel_check,
+                        max_hashes) -> None:
+    """Shared unsatisfiable-difficulty gate (both drivers).
+
+    Unsatisfiable: the digest only has max_difficulty nibbles.  The
+    reference would brute-force forever (worker.go:246-256 never
+    reaches the threshold); we busy-wait on the cancel/budget gates
+    instead of burning the device.  With NEITHER gate supplied the
+    wait could never end — a trap for bare library callers (the
+    worker always passes a cancel_check), so that combination
+    raises instead (VERDICT r3 weak #4 / item 7).
+    """
+    if cancel_check is None and max_hashes is None:
+        raise ValueError(
+            f"difficulty {difficulty} exceeds {model.name}'s "
+            f"{model.max_difficulty} digest nibbles (unsatisfiable) "
+            f"and no cancel_check/max_hashes gate was supplied; the "
+            f"search could never return"
+        )
+    # (no watchdog involvement: this loop never touches the device,
+    # and beating here could mask a genuinely hung concurrent search
+    # on the shared staleness clock)
+    while True:
+        if cancel_check is not None and cancel_check():
+            return None
+        if max_hashes is not None:
+            return None
+        time.sleep(0.01)
+
+
 def default_step_factory(
     nonce: bytes,
     difficulty: int,
@@ -271,29 +305,8 @@ def search(
     nonce = bytes(nonce)
     tb_lo, tbc = contiguous_bounds(thread_bytes)
     if difficulty > model.max_difficulty:
-        # Unsatisfiable: the digest only has max_difficulty nibbles.  The
-        # reference would brute-force forever (worker.go:246-256 never
-        # reaches the threshold); we busy-wait on the cancel/budget gates
-        # instead of burning the device.  With NEITHER gate supplied the
-        # wait could never end — a trap for bare library callers (the
-        # worker always passes a cancel_check), so that combination
-        # raises instead (VERDICT r3 weak #4 / item 7).
-        if cancel_check is None and max_hashes is None:
-            raise ValueError(
-                f"difficulty {difficulty} exceeds {model.name}'s "
-                f"{model.max_difficulty} digest nibbles (unsatisfiable) "
-                f"and no cancel_check/max_hashes gate was supplied; the "
-                f"search could never return"
-            )
-        # (no watchdog involvement: this loop never touches the device,
-        # and beating here could mask a genuinely hung concurrent search
-        # on the shared staleness clock)
-        while True:
-            if cancel_check is not None and cancel_check():
-                return None
-            if max_hashes is not None:
-                return None
-            time.sleep(0.01)
+        return _unsatisfiable_wait(model, difficulty, cancel_check,
+                                   max_hashes)
     factory = step_factory or default_step_factory(
         nonce, difficulty, tb_lo, tbc, model
     )
@@ -312,7 +325,11 @@ def search(
         # the sanctioned host sync: time blocked on the launch's result
         # fetch — the per-launch latency distribution (pipelined, so a
         # busy pipeline shows near-zero waits; a dry one shows the full
-        # device+tunnel round trip)
+        # device+tunnel round trip).  Counted as a blocking sync: the
+        # conversion is issued without readiness confirmed, which is
+        # exactly what the persistent driver's polling drain avoids
+        # (bench.py --serving-loop measures the two against each other)
+        metrics.inc("search.blocking_syncs")
         fetch_t0 = time.monotonic()
         f = int(res)
         metrics.observe("search.launch_s", time.monotonic() - fetch_t0)
@@ -391,6 +408,11 @@ def search(
                             return None
                         if max_hashes is not None and hashes >= max_hashes:
                             found = drain_all()
+                            # drain_all stops at the first hit: launches
+                            # still in flight behind it must be counted
+                            # (search.hashes == dispatched work on every
+                            # exit path, flush_inflight_counts)
+                            flush_inflight_counts()
                             if found is not None:
                                 metrics.inc("search.found")
                             return found
@@ -418,6 +440,279 @@ def search(
                                 metrics.inc("search.found")
                                 return found
                     found = drain_all()
+                    if found is not None:
+                        flush_inflight_counts()
+                        metrics.inc("search.found")
+                        return found
+        return None
+    finally:
+        _RATE_METER.exit()
+
+
+# Host-side poll cadence while a launch result is not yet ready.  Short
+# enough that drain latency adds negligibly to a launch's wall-clock
+# (launches are 0.1-0.25 s of device work by budget), long enough that
+# polling is not a busy spin over the tunnel.
+DEFAULT_POLL_INTERVAL_S = 0.001
+
+
+class StopFlag:
+    """Host-writable device stop flag for the persistent loop
+    (docs/SERVING.md flag protocol).
+
+    The flag is a one-element uint32 device buffer passed to every
+    persistent dispatch; the on-device while_loop reads it in its loop
+    condition, so a dispatch carrying a set flag exits after one
+    condition check instead of burning its full segment budget.  The
+    host "writes" it by replacing the buffer (``set()`` updates the
+    operand the NEXT dispatches bind — JAX buffers are immutable, so
+    already-enqueued dispatches still run their remaining segments).
+    Two call sites exercise the SET form today: backend warmup, which
+    compiles the persistent programs against a set flag so compilation
+    costs near-zero device work, and any dispatch a driver issues
+    after observing a cancel — the solo driver never issues one (it
+    stops dispatching the moment it observes the cancel), so there the
+    flag is the invariant guard, not the cancel mechanism: cancel
+    latency is bounded by stop-on-observe plus the ≤ ``pipeline_depth``
+    already-in-flight dispatches running out in the background (each
+    still early-exits on its own hit).  The buffer is created lazily
+    and reused across dispatches, so the steady-state cost is zero
+    transfers.
+    """
+
+    __slots__ = ("_operand", "_set")
+
+    def __init__(self) -> None:
+        self._operand = None
+        self._set = False
+
+    def set(self) -> None:
+        self._set = True
+        self._operand = None  # rebuilt hot with the new value
+
+    def is_set(self) -> bool:
+        return self._set
+
+    def operand(self):
+        if self._operand is None:
+            import jax.numpy as jnp
+
+            self._operand = jnp.uint32(1 if self._set else 0)
+        return self._operand
+
+
+def persistent_search(
+    nonce: bytes,
+    difficulty: int,
+    thread_bytes: Sequence[int],
+    *,
+    model: Optional[HashModel] = None,
+    batch_size: int = DEFAULT_BATCH,
+    pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+    cancel_check: Optional[Callable[[], bool]] = None,
+    max_hashes: Optional[int] = None,
+    max_width: int = 8,
+    launch_candidates: Optional[int] = None,
+    poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
+) -> Optional[SearchResult]:
+    """Persistent-loop twin of :func:`search` — same contract, same
+    first-hit enumeration order, byte-identical results (the golden
+    parity suite, tests/test_serving_loop.py, asserts it).
+
+    Three differences from the relaunch loop, all on the host side of
+    the dispatch boundary (docs/SERVING.md):
+
+    * each dispatch is a multi-segment on-device loop
+      (``cached_persistent_step``) that early-exits on the first hit,
+      so the per-dispatch candidate budget no longer trades hit
+      latency against round-trip amortization;
+    * the drain POLLS the in-flight head's readiness
+      (``jax.Array.is_ready`` — a cheap flag query, not a result
+      fetch) and only converts once ready, so the host never blocks
+      inside a result fetch (``search.blocking_syncs`` stays flat;
+      the waiting time is observable as ``search.poll_s``);
+    * cancellation stops issuing dispatches the moment it is observed
+      (and flips the :class:`StopFlag` future dispatches would carry —
+      see its docstring for what actually exercises the set form):
+      the host returns immediately, and the abandoned device work is
+      bounded at the in-flight window (≤ ``pipeline_depth`` dispatches
+      running out their segment budget in the background) without
+      shrinking launches.
+    """
+    model = model or get_hash_model("md5")
+    if launch_candidates is None:
+        launch_candidates = scaled_launch_candidates(model.cost_ops)
+    nonce = bytes(nonce)
+    tb_lo, tbc = contiguous_bounds(thread_bytes)
+    if difficulty > model.max_difficulty:
+        return _unsatisfiable_wait(model, difficulty, cancel_check,
+                                   max_hashes)
+    target_chunks = max(1, effective_batch(batch_size) // tbc)
+    stop = StopFlag()
+
+    hashes = 0
+    # FIFO of in-flight dispatches:
+    # (res, chunk0, vw, extra, seg_chunks, chunks_each, is_pair)
+    # where seg_chunks is the dispatch's IN-SEGMENT chunk span (the
+    # overscan clip the serial driver documents at its n_cand line) and
+    # chunks_each the chunk count of one on-device segment.
+    inflight: deque = deque()
+
+    def _fetch_pair(res):
+        # the conversion site: only ever entered with res.is_ready()
+        # confirmed, so this does not serialize the pipeline
+        f = int(res[0])
+        segs = int(res[1])
+        return f, segs
+
+    def drain_one() -> Tuple[Optional[SearchResult], bool]:
+        """Poll the head to readiness, then convert.  Returns
+        ``(found, cancelled)`` — polling honors cancel_check, so a
+        cancel arriving mid-wait stops the search without blocking on
+        the device."""
+        nonlocal hashes
+        res, chunk0, vw, extra, seg_chunks, chunks_each, is_pair = \
+            inflight.popleft()
+        poll_t0 = time.monotonic()
+        waited = False
+        # deliberately NO WATCHDOG.beat() inside the poll wait: a hung
+        # device leaves is_ready() false forever, and beating here
+        # would mask exactly the staleness the hang watchdog exists to
+        # convert into a visible death — the poll wait accumulates
+        # staleness like the serial driver's blocking fetch does
+        while not res.is_ready():
+            waited = True
+            if cancel_check is not None and cancel_check():
+                stop.set()
+                # account the popped head like the rest of the flush:
+                # dispatched work the device completes either way
+                hashes += seg_chunks * tbc
+                metrics.inc("search.hashes", seg_chunks * tbc)
+                return None, True
+            time.sleep(poll_interval_s)
+        if waited:
+            metrics.observe("search.poll_s", time.monotonic() - poll_t0)
+        if is_pair:
+            f, segs = _fetch_pair(res)
+            metrics.inc("search.persistent_steps", segs)
+            n_cand = min(segs * chunks_each, seg_chunks) * tbc
+        else:
+            # width-0 probe: single 256-candidate launch, polled to
+            # readiness above like every other dispatch — the
+            # conversion cannot block
+            f = int(res)
+            n_cand = seg_chunks * tbc
+        hashes += n_cand
+        metrics.inc("search.hashes", n_cand)
+        _RATE_METER.note(n_cand)
+        if f == SENTINEL:
+            return None, False
+        secret, tb = assemble_secret(chunk0, f, vw, extra, tb_lo, tbc)
+        if not puzzle.check_secret(nonce, secret, difficulty, model.name):
+            raise RuntimeError(
+                f"kernel returned non-solving candidate tb={tb} "
+                f"chunk={secret[1:].hex()} (kernel/oracle divergence)"
+            )
+        return SearchResult(
+            secret=secret, thread_byte=tb, chunk=secret[1:],
+            hashes_tried=hashes,
+        ), False
+
+    def flush_inflight_counts() -> None:
+        # same accounting contract as the serial driver: dispatched
+        # work counts on every exit path without paying a fetch per
+        # launch (launches carrying a set stop flag exit early on
+        # device, so this is an upper bound there — documented in
+        # docs/SERVING.md)
+        nonlocal hashes
+        while inflight:
+            _res, _c0, _vw, _ex, seg_chunks, _ce, _p = inflight.popleft()
+            hashes += seg_chunks * tbc
+            metrics.inc("search.hashes", seg_chunks * tbc)
+
+    def drain_all() -> Tuple[Optional[SearchResult], bool]:
+        while inflight:
+            found, cancelled = drain_one()
+            if found is not None or cancelled:
+                return found, cancelled
+        return None, False
+
+    _RATE_METER.enter()
+    try:
+        with WATCHDOG.active():
+            for width in range(0, max_width + 1):
+                for vw, lo, hi, extra in width_segments(width):
+                    WATCHDOG.beat()  # step build may compile below
+                    k = launch_steps_for(vw, target_chunks, tbc,
+                                         launch_candidates)
+                    if vw == 0:
+                        step0 = cached_search_step(
+                            nonce, 0, difficulty, tb_lo, tbc, 1,
+                            model.name, extra, 1,
+                        )
+                        step, chunks_per_step, chunks_each = \
+                            None, 1, 1
+                    else:
+                        step = cached_persistent_step(
+                            nonce, vw, difficulty, tb_lo, tbc,
+                            target_chunks, model.name, extra, k,
+                        )
+                        chunks_each = target_chunks
+                        chunks_per_step = target_chunks * k
+                    chunk0 = lo
+                    first_launch = True
+                    while chunk0 < hi:
+                        seg_chunks = min(chunks_per_step, hi - chunk0)
+                        WATCHDOG.beat()
+                        if cancel_check is not None and cancel_check():
+                            stop.set()
+                            flush_inflight_counts()
+                            metrics.inc("search.cancelled")
+                            return None
+                        if max_hashes is not None and hashes >= max_hashes:
+                            found, cancelled = drain_all()
+                            # drain_all stops at the first hit/cancel:
+                            # dispatches still in flight behind it must
+                            # count like every other exit path
+                            flush_inflight_counts()
+                            if cancelled:
+                                metrics.inc("search.cancelled")
+                                return None
+                            if found is not None:
+                                metrics.inc("search.found")
+                            return found
+                        c = chunk0 & 0xFFFFFFFF
+                        if first_launch:
+                            first_launch = False
+                            # first dispatch of a segment may compile
+                            # (same grace rationale as the serial
+                            # driver's cold-layout launch)
+                            with WATCHDOG.grace(FIRST_COMPILE_GRACE_S):
+                                res = step0(c) if vw == 0 else \
+                                    step(c, stop.operand())
+                        else:
+                            res = step0(c) if vw == 0 else \
+                                step(c, stop.operand())
+                        metrics.inc("search.launches")
+                        inflight.append((res, chunk0, vw, extra,
+                                         seg_chunks, chunks_each,
+                                         vw != 0))
+                        chunk0 += chunks_per_step
+                        if len(inflight) >= pipeline_depth:
+                            found, cancelled = drain_one()
+                            if cancelled:
+                                flush_inflight_counts()
+                                metrics.inc("search.cancelled")
+                                return None
+                            if found is not None:
+                                flush_inflight_counts()
+                                metrics.inc("search.found")
+                                return found
+                    found, cancelled = drain_all()
+                    if cancelled:
+                        flush_inflight_counts()
+                        metrics.inc("search.cancelled")
+                        return None
                     if found is not None:
                         flush_inflight_counts()
                         metrics.inc("search.found")

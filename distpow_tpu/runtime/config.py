@@ -174,6 +174,13 @@ class WorkerConfig:
     JaxCoordinator: str = ""
     JaxNumProcesses: int = 1
     JaxProcessId: int = 0
+    # Serving loop for the single-device XLA backend (docs/SERVING.md):
+    # "persistent" (default) drives the multi-segment on-device search
+    # loop with a polling drain — the host never blocks inside a
+    # per-launch result fetch; "serial" keeps the pre-persistent
+    # launch/fetch/relaunch loop (the bench.py --serving-loop baseline
+    # and the escape hatch).
+    SearchLoop: str = "persistent"
     # Dev-only: run the pallas/pallas-mesh kernels in interpret mode so
     # kernel-backed workers can serve off-TPU (CI, the CPU mesh demo).
     # Orders of magnitude slower than the XLA step on CPU — never set in
@@ -199,6 +206,14 @@ class WorkerConfig:
     # (also the preemption bound — requests beyond it wait in the run
     # queue under deterministic weighted-fair rotation).
     SchedMaxSlots: int = 8
+    # Extra hash models the batching scheduler admits to its packed
+    # step BEYOND HashModel: slots of different models then share one
+    # mixed-hash launch (per-model sub-batches inside one compiled
+    # program — docs/SERVING.md).  A Mine carrying a "hash_model" param
+    # outside this set (or an XLA-serving-impractical model) routes to
+    # the solo path instead.  Empty = HashModel only (pre-PR-6
+    # behavior: any other hash forfeits batching).
+    SchedHashModels: List[str] = field(default_factory=list)
 
 
 @dataclass

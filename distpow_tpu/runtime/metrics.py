@@ -18,6 +18,13 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
 * ``search.launches``      — device dispatches
 * ``search.cancelled``     — searches stopped by a cancel check
 * ``search.found``         — searches that returned a secret
+* ``search.blocking_syncs`` — result conversions issued WITHOUT
+  readiness confirmed (the serial drain's per-launch ``int(res)``;
+  the persistent loop's polling drain keeps this flat —
+  parallel/search.py, docs/SERVING.md)
+* ``search.persistent_steps`` — on-device sub-batches (segments)
+  executed inside persistent-loop dispatches (early-exit means this
+  can be far below the dispatched segment budget)
 * ``worker.mine_rpcs`` / ``worker.found_rpcs`` / ``worker.cancel_rpcs``
 * ``worker.results_sent``  — messages queued to the forwarder
 * ``worker.forward_retries`` — result deliveries retried after a
@@ -33,6 +40,9 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
   retries (non-counting: they never burn the transport retry budget)
 * ``sched.launches`` — batched device dispatches issued by the
   continuous-batching engine (sched/engine.py)
+* ``sched.mixed_hash_launches`` — batched launches whose slot set
+  spans more than one hash model (per-model sub-batches inside one
+  compiled program — sched/engine.py, docs/SERVING.md)
 * ``sched.admission_rejected`` — Mine requests shed by the
   coordinator's bounded run queue (sched/admission.py)
 * ``sched.coalesced_requests`` — duplicate in-flight Mines attached as
@@ -71,7 +81,10 @@ Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 * ``worker.solve_s``          — backend search latency for found secrets
 * ``worker.time_to_cancel_s`` — Mine receipt to honored cancellation
 * ``search.launch_s``  — time blocked fetching one launch's result
-  (the driver's FIFO drain; parallel/search.py)
+  (the serial driver's FIFO drain; parallel/search.py)
+* ``search.poll_s``    — time spent POLLING a launch to readiness in
+  the persistent driver's drain (the host stays responsive — cancel
+  checks run between polls; docs/SERVING.md)
 * ``powlib.mine_s``    — client-observed mine round-trip incl. retries
 * ``sched.batch_occupancy`` — real (non-padding) slots per batched
   launch: the continuous-batching win is this distribution's mean
@@ -104,6 +117,7 @@ Number = Union[int, float]
 # docstring list above and this set in sync (test_lint.py asserts it).
 KNOWN_COUNTERS = frozenset({
     "search.hashes", "search.launches", "search.cancelled", "search.found",
+    "search.blocking_syncs", "search.persistent_steps",
     "worker.mine_rpcs", "worker.found_rpcs", "worker.cancel_rpcs",
     "worker.results_sent", "worker.forward_retries",
     "coord.mine_rpcs", "coord.fanouts", "coord.late_results",
@@ -115,6 +129,7 @@ KNOWN_COUNTERS = frozenset({
     "sched.launches", "sched.admission_rejected",
     "sched.coalesced_requests", "sched.slots_preempted",
     "sched.fallback_searches", "sched.loop_failures",
+    "sched.mixed_hash_launches",
     "rpc.handler_errors",
     "rpc.codec.negotiated_v2", "rpc.codec.fallback_v1",
     "coord.abandoned_resyncs",
@@ -136,7 +151,7 @@ KNOWN_HISTOGRAMS = frozenset({
     "coord.mine_s.hit", "coord.mine_s.miss",
     "coord.first_result_s", "coord.cancel_propagation_s",
     "worker.solve_s", "worker.time_to_cancel_s",
-    "search.launch_s",
+    "search.launch_s", "search.poll_s",
     "powlib.mine_s",
     "sched.batch_occupancy", "sched.slot_wait_s",
     "rpc.frame.sent_bytes", "rpc.frame.recv_bytes",

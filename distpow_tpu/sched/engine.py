@@ -50,7 +50,12 @@ from ..models import puzzle
 from ..models.registry import get_hash_model
 from ..ops.difficulty import nibble_masks
 from ..ops.packing import build_tail_spec
-from ..ops.search_step import SENTINEL, slot_search_step
+from ..ops.search_step import (
+    SENTINEL,
+    XLA_SERVING_COMPILE_IMPRACTICAL,
+    mixed_slot_search_step,
+    slot_search_step,
+)
 from ..parallel.partition import contiguous_bounds
 from ..parallel.search import assemble_secret, effective_batch, width_segments
 from ..runtime.metrics import REGISTRY as metrics
@@ -68,18 +73,22 @@ _IDLE_TICK_S = 0.02
 class Slot:
     """One active search's scheduler state.  ``done`` fires exactly once
     with either ``secret`` set (hit), ``secret=None`` (cancelled or
-    enumeration exhausted), or ``error`` set (engine failure)."""
+    enumeration exhausted), or ``error`` set (engine failure).
+    ``model`` is the slot's hash model — slots of different models can
+    share a mixed-hash launch (docs/SERVING.md)."""
 
     __slots__ = (
         "seq", "nonce", "ntz", "tb_lo", "tbc", "log_tbc", "weight",
         "cancel_check", "masks", "done", "secret", "error", "vtime",
         "launches", "submitted_t", "first_launch_t", "exhausted",
         "_segments", "vw", "seg_hi", "extra", "spec", "chunk0",
-        "_cancelled",
+        "_cancelled", "model",
     )
 
     def __init__(self, seq: int, nonce: bytes, ntz: int, tb_lo: int,
-                 tbc: int, cancel_check, weight: float, masks, segments):
+                 tbc: int, cancel_check, weight: float, masks, segments,
+                 model):
+        self.model = model
         self.seq = seq
         self.nonce = nonce
         self.ntz = ntz
@@ -136,8 +145,19 @@ class BatchingScheduler:
 
     def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20,
                  max_slots: int = 8, max_width: int = 8, fallback=None,
-                 start: bool = True):
+                 start: bool = True, extra_models: Sequence[str] = ()):
         self.model = get_hash_model(hash_model)
+        # models the packed step serves: the default plus any configured
+        # extras (WorkerConfig.SchedHashModels).  Slots of different
+        # models share one mixed-hash launch; models whose fused XLA
+        # serving step is impractical to compile stay on the solo route
+        # regardless (XLA_SERVING_COMPILE_IMPRACTICAL — on TPU those are
+        # served by the Pallas kernels through a solo backend).
+        self.models = {self.model.name: self.model}
+        for name in extra_models:
+            m = get_hash_model(name)
+            if m.name not in XLA_SERVING_COMPILE_IMPRACTICAL:
+                self.models[m.name] = m
         self.batch = effective_batch(batch_size)
         self.max_slots = max(1, int(max_slots))
         self.max_width = max_width
@@ -186,23 +206,30 @@ class BatchingScheduler:
             self._finish(s, None)
 
     # -- submission ---------------------------------------------------------
-    def supports(self, difficulty: int, thread_bytes: Sequence[int]) -> bool:
-        """True when the packed step can serve this shape: a contiguous
-        power-of-two partition and a satisfiable difficulty."""
+    def supports(self, difficulty: int, thread_bytes: Sequence[int],
+                 hash_model: Optional[str] = None) -> bool:
+        """True when the packed step can serve this shape: an admitted
+        hash model, a contiguous power-of-two partition and a
+        satisfiable difficulty."""
+        model = self.models.get(hash_model or self.model.name)
+        if model is None:
+            return False
         try:
             _, tbc = contiguous_bounds(thread_bytes)
         except ValueError:
             return False
         return (0 < tbc <= 256 and tbc & (tbc - 1) == 0
-                and difficulty <= self.model.max_difficulty)
+                and difficulty <= model.max_difficulty)
 
     def submit(self, nonce: bytes, difficulty: int,
                thread_bytes: Sequence[int],
                cancel_check: Optional[Callable[[], bool]] = None,
-               weight: float = 1.0) -> Slot:
+               weight: float = 1.0,
+               hash_model: Optional[str] = None) -> Slot:
+        model = self.models[hash_model or self.model.name]
         nonce = bytes(nonce)
         tb_lo, tbc = contiguous_bounds(thread_bytes)
-        masks = nibble_masks(difficulty, self.model)
+        masks = nibble_masks(difficulty, model)
         segments = self._segment_stream()
         with self._cond:
             if self._dead:
@@ -211,7 +238,7 @@ class BatchingScheduler:
                 )
             self._seq += 1
             slot = Slot(self._seq, nonce, difficulty, tb_lo, tbc,
-                        cancel_check, weight, masks, segments)
+                        cancel_check, weight, masks, segments, model)
             # virtual-clock floor: a joining slot starts at the
             # currently most-starved slot's vtime, not 0 — otherwise a
             # stream of fresh arrivals (each sorting first at vtime 0)
@@ -228,9 +255,19 @@ class BatchingScheduler:
             self._cond.notify_all()
         return slot
 
-    def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
-        """Backend-compatible facade: first solving secret or None."""
-        if self._dead or not self.supports(difficulty, thread_bytes):
+    def _solo(self, nonce, difficulty, thread_bytes, cancel_check,
+              hash_model: Optional[str]):
+        """Route one search outside the packed step.
+
+        Default-model shapes go to the wrapped fallback backend (it was
+        built for that model).  Off-default models the packed step
+        cannot serve — impractical-to-compile, not configured, or an
+        unsupported shape — run through the solo XLA driver with the
+        requested model instead: the fallback backend's model would be
+        WRONG for them (docs/SERVING.md; on TPU, serve those models
+        from a worker whose configured backend is their Pallas kernel).
+        """
+        if hash_model is None or hash_model == self.model.name:
             if self.fallback is None:
                 raise ValueError(
                     f"unsupported search shape for the batching scheduler "
@@ -240,19 +277,49 @@ class BatchingScheduler:
             return self.fallback.search(
                 nonce, difficulty, thread_bytes, cancel_check=cancel_check
             )
+        model = get_hash_model(hash_model)
+        if model.name in XLA_SERVING_COMPILE_IMPRACTICAL:
+            # never run these through the solo XLA driver either: the
+            # fused serving step is the thing that is impractical to
+            # compile (>30 min observed on the TPU backend, r4c), and
+            # a "fallback" that wedges the miner thread and device in
+            # that compile is worse than an honest refusal
+            raise ValueError(
+                f"hash model {model.name!r} is never admitted to the XLA "
+                f"serving path (XLA_SERVING_COMPILE_IMPRACTICAL): serve "
+                f"it from a worker whose configured backend is its "
+                f"Pallas kernel"
+            )
+        metrics.inc("sched.fallback_searches")
+        from ..parallel.search import persistent_search
+
+        res = persistent_search(
+            nonce, difficulty, thread_bytes,
+            model=model, batch_size=self.batch,
+            cancel_check=cancel_check,
+        )
+        return None if res is None else res.secret
+
+    def search(self, nonce, difficulty, thread_bytes, cancel_check=None,
+               hash_model: Optional[str] = None):
+        """Backend-compatible facade: first solving secret or None."""
+        if self._dead or not self.supports(difficulty, thread_bytes,
+                                           hash_model):
+            return self._solo(nonce, difficulty, thread_bytes,
+                              cancel_check, hash_model)
         try:
             slot = self.submit(nonce, difficulty, thread_bytes,
-                               cancel_check=cancel_check)
+                               cancel_check=cancel_check,
+                               hash_model=hash_model)
         except RuntimeError:
             # closed/died between the liveness check and the append —
             # the slot was never queued, so serve solo rather than
             # hang or leak the race to the miner thread
-            if self.fallback is None:
+            if self.fallback is None and (hash_model is None
+                                          or hash_model == self.model.name):
                 raise
-            metrics.inc("sched.fallback_searches")
-            return self.fallback.search(
-                nonce, difficulty, thread_bytes, cancel_check=cancel_check
-            )
+            return self._solo(nonce, difficulty, thread_bytes,
+                              cancel_check, hash_model)
         return slot.result()
 
     # -- cursor -------------------------------------------------------------
@@ -267,17 +334,19 @@ class BatchingScheduler:
             slot.seg_hi = hi
             slot.extra = extra
             slot.chunk0 = lo
-            slot.spec = build_tail_spec(slot.nonce, vw, self.model, extra)
+            slot.spec = build_tail_spec(slot.nonce, vw, slot.model, extra)
             return True
         return False
 
     @staticmethod
     def _group_key(slot: Slot):
-        # slots sharing a tail layout can share one compiled program;
-        # the spec's (n_blocks, tb_loc, chunk_locs) IS the layout key
-        # the single-slot dynamic regime already compiles on
+        # slots sharing (model, tail layout) can share one vmapped lane
+        # stack; DIFFERENT groups still share the LAUNCH through the
+        # mixed step, whose compile key is the ordered group-key set
+        # (ops/search_step.py mixed_slot_search_step)
         spec = slot.spec
-        return (spec.n_blocks, spec.tb_loc, spec.chunk_locs)
+        return (slot.model.name, spec.n_blocks, spec.tb_loc,
+                spec.chunk_locs)
 
     # -- the device loop ----------------------------------------------------
     def _loop(self) -> None:
@@ -342,83 +411,121 @@ class BatchingScheduler:
     def _pick_locked(self) -> Optional[List[Slot]]:
         if not self._active:
             return None
-        leader = min(self._active, key=lambda s: (s.vtime, s.seq))
-        key = self._group_key(leader)
-        cohort = sorted(
-            (s for s in self._active if self._group_key(s) == key),
-            key=lambda s: (s.vtime, s.seq),
+        # most-starved first across ALL groups: slots of different
+        # models share a mixed-hash launch, so fairness ordering no
+        # longer forfeits batching at model boundaries
+        cohort = sorted(self._active, key=lambda s: (s.vtime, s.seq))
+        cohort = cohort[: self.max_slots]
+        # serve at most ONE layout group per model: batching across
+        # models is the occupancy win (solo fallback served exactly 1),
+        # but batching across LAYOUTS buys nothing the fair clock
+        # doesn't already deliver by rotating groups — and every layout
+        # SUBSET the join/leave churn produced would be a fresh
+        # mixed-step compile key (the power-of-two lane pad bounds
+        # pads, not subsets).  This caps a launch's group count at the
+        # admitted-model count, so compile keys stay bounded by
+        # model-subsets x per-model (layout, pad).
+        keep = {}
+        for s in cohort:  # cohort is (vtime, seq)-ordered: first slot
+            keep.setdefault(s.model.name, self._group_key(s))  # leads
+        cohort = [s for s in cohort
+                  if self._group_key(s) == keep[s.model.name]]
+        return cohort
+
+    @staticmethod
+    def _lane_ops(lanes: List[Slot]):
+        import jax.numpy as jnp
+
+        return (
+            jnp.asarray([s.spec.init_state for s in lanes], jnp.uint32),
+            jnp.asarray([s.spec.base_words for s in lanes], jnp.uint32),
+            jnp.asarray([s.masks for s in lanes], jnp.uint32),
+            jnp.asarray([s.tb_lo for s in lanes], jnp.uint32),
+            jnp.asarray([s.log_tbc for s in lanes], jnp.uint32),
+            jnp.asarray([s.chunk0 & 0xFFFFFFFF for s in lanes],
+                        jnp.uint32),
         )
-        return cohort[: self.max_slots]
 
     def _launch(self, group: List[Slot]) -> None:
         import jax
-        import jax.numpy as jnp
 
-        n = len(group)
-        n_pad = 1 << (n - 1).bit_length()
-        lanes = group + [group[-1]] * (n_pad - n)
-        spec = group[0].spec
-        init = jnp.asarray([s.spec.init_state for s in lanes], jnp.uint32)
-        base = jnp.asarray([s.spec.base_words for s in lanes], jnp.uint32)
-        masks = jnp.asarray([s.masks for s in lanes], jnp.uint32)
-        tb_lo = jnp.asarray([s.tb_lo for s in lanes], jnp.uint32)
-        log_tbc = jnp.asarray([s.log_tbc for s in lanes], jnp.uint32)
-        chunk0 = jnp.asarray([s.chunk0 & 0xFFFFFFFF for s in lanes],
-                             jnp.uint32)
-        compile_key = (self.model.name, spec.n_blocks, spec.tb_loc,
-                       spec.chunk_locs, self.batch, n_pad)
+        # group the cohort by (model, layout): each group is one vmapped
+        # lane stack; all groups share the single dispatch.  Per-group
+        # lane counts pad to a power of two so the compile-key space
+        # stays bounded the way the single-group n_pad already was.
+        by_key: dict = {}
+        for s in group:
+            by_key.setdefault(self._group_key(s), []).append(s)
+        ordered = sorted(by_key.items(), key=lambda kv: kv[0])
+        gdefs, gops, gslots = [], [], []
+        for key, slots in ordered:
+            model_name, n_blocks, tb_loc, chunk_locs = key
+            n_pad = 1 << (len(slots) - 1).bit_length()
+            lanes = slots + [slots[-1]] * (n_pad - len(slots))
+            gdefs.append((model_name, n_blocks, tb_loc, chunk_locs, n_pad))
+            gops.append(self._lane_ops(lanes))
+            gslots.append(slots)
+        compile_key = (tuple(gdefs), self.batch)
         first_compile = compile_key not in self._compiled
-        step = slot_search_step(
-            self.model.name, spec.n_blocks, spec.tb_loc, spec.chunk_locs,
-            self.batch, n_pad,
-        )
+        if len(gdefs) == 1:
+            m, nb, tl, cl, n_pad = gdefs[0]
+            step = slot_search_step(m, nb, tl, cl, self.batch, n_pad)
+
+            def run():
+                return (jax.device_get(step(*gops[0])),)
+        else:
+            step = mixed_slot_search_step(tuple(gdefs), self.batch)
+
+            def run():
+                return jax.device_get(step(tuple(gops)))
         now = time.monotonic()
         with WATCHDOG.active():
             WATCHDOG.beat()
             if first_compile:
                 self._compiled.add(compile_key)
                 with WATCHDOG.grace(FIRST_COMPILE_GRACE_S):
-                    res = jax.device_get(
-                        step(init, base, masks, tb_lo, log_tbc, chunk0)
-                    )
+                    res_groups = run()
             else:
-                res = jax.device_get(
-                    step(init, base, masks, tb_lo, log_tbc, chunk0)
-                )
+                res_groups = run()
 
-        metrics.observe("sched.batch_occupancy", n)
+        metrics.observe("sched.batch_occupancy", len(group))
         metrics.inc("sched.launches")
-        metrics.inc("search.hashes", n * self.batch)
+        if len({d[0] for d in gdefs}) > 1:
+            metrics.inc("sched.mixed_hash_launches")
+        metrics.inc("search.hashes", len(group) * self.batch)
         finished: List[Tuple[Slot, Optional[bytes]]] = []
-        for i, s in enumerate(group):
-            s.launches += 1
-            s.vtime += self.batch / s.weight
-            if s.first_launch_t is None:
-                s.first_launch_t = now
-                metrics.observe("sched.slot_wait_s", now - s.submitted_t)
-            f = int(res[i])
-            if f != SENTINEL:
-                secret, _ = assemble_secret(
-                    s.chunk0, f, s.vw, s.extra, s.tb_lo, s.tbc
-                )
-                if not puzzle.check_secret(s.nonce, secret, s.ntz,
-                                           self.model.name):
-                    # kernel/oracle divergence: fail THIS slot loudly,
-                    # keep the loop serving the others (the solo driver
-                    # kills its whole miner thread here)
-                    finished.append((s, None))
-                    s.error = (
-                        f"packed step returned non-solving candidate "
-                        f"{secret.hex()} (kernel/oracle divergence)"
+        for slots, res in zip(gslots, res_groups):
+            for i, s in enumerate(slots):
+                s.launches += 1
+                s.vtime += self.batch / s.weight
+                if s.first_launch_t is None:
+                    s.first_launch_t = now
+                    metrics.observe("sched.slot_wait_s",
+                                    now - s.submitted_t)
+                # distpow: ok relaunch-loop-sync -- res is a fetched host array (the single device_get above is this launch's one sanctioned sync); converting lanes here cannot block on the device
+                f = int(res[i])
+                if f != SENTINEL:
+                    secret, _ = assemble_secret(
+                        s.chunk0, f, s.vw, s.extra, s.tb_lo, s.tbc
                     )
+                    if not puzzle.check_secret(s.nonce, secret, s.ntz,
+                                               s.model.name):
+                        # kernel/oracle divergence: fail THIS slot
+                        # loudly, keep the loop serving the others (the
+                        # solo driver kills its whole miner thread here)
+                        finished.append((s, None))
+                        s.error = (
+                            f"packed step returned non-solving candidate "
+                            f"{secret.hex()} (kernel/oracle divergence)"
+                        )
+                        continue
+                    metrics.inc("search.found")
+                    finished.append((s, secret))
                     continue
-                metrics.inc("search.found")
-                finished.append((s, secret))
-                continue
-            s.chunk0 += self.batch >> s.log_tbc
-            if s.chunk0 >= s.seg_hi and not self._advance_segment(s):
-                s.exhausted = True
-                finished.append((s, None))
+                s.chunk0 += self.batch >> s.log_tbc
+                if s.chunk0 >= s.seg_hi and not self._advance_segment(s):
+                    s.exhausted = True
+                    finished.append((s, None))
         with self._cond:
             for s, _ in finished:
                 if s in self._active:

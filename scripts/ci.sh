@@ -25,7 +25,12 @@
 # (scripts/wire_smoke.py, docs/RPC.md): wire-v2 negotiation, parallel
 # fan-out seams recorded, chaos on binary frames ridden out, and a
 # JSON-pinned client interoperating — ~20 s, pure CPU.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke]
+# `--serving-smoke` runs the deterministic serving-loop smoke
+# (scripts/serving_smoke.py, docs/SERVING.md): the persistent loop must
+# match the serial driver's first hit with zero blocking host syncs,
+# and a mixed-hash (md5+sha1) batch through an in-process worker must
+# spend fewer launches than the per-model solo baseline — ~30 s, CPU.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -67,6 +72,13 @@ if [ "${1:-}" = "--wire-smoke" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "--serving-smoke" ]; then
+  echo "=== serving smoke (persistent loop + mixed-hash batch, CPU platform) ==="
+  JAX_PLATFORMS=cpu python scripts/serving_smoke.py
+  echo "=== serving smoke OK ==="
+  exit 0
+fi
+
 if [ "${1:-}" = "--bench-rehearsal" ]; then
   echo "=== bench rehearsal (CPU platform, temp provenance) ==="
   tmp="$(mktemp -d)"
@@ -105,7 +117,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke]" >&2
           exit 2 ;;
 esac
 

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Deterministic serving-loop smoke (scripts/ci.sh --serving-smoke).
+
+Two halves on the CPU platform (docs/SERVING.md):
+
+1. **Persistent loop** — one solve through the persistent driver next
+   to the same solve through the serial baseline: first hits must be
+   byte-identical and the persistent drain must issue ZERO blocking
+   host syncs while the serial loop pays one per launch.
+2. **Mixed-hash batch** — an in-process worker (real WorkerRPCHandler,
+   real miner threads, real result queue) with a md5+sha1 batching
+   scheduler serves an interleaved md5/sha1 Mine batch; every secret is
+   host-verified under ITS OWN model, the batch must spend fewer
+   launches than the same requests served one at a time (the per-model
+   solo baseline), and at least one launch must actually mix models
+   (``sched.mixed_hash_launches``).
+
+Prints one JSON summary line on stdout (details to stderr); exit 0 on
+success — the shape scripts/sched_smoke.py established for CI lanes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distpow_tpu.backends import get_backend  # noqa: E402
+from distpow_tpu.models import puzzle  # noqa: E402
+from distpow_tpu.nodes.worker import WorkerRPCHandler  # noqa: E402
+from distpow_tpu.parallel.search import (  # noqa: E402
+    persistent_search,
+    search,
+)
+from distpow_tpu.runtime.metrics import REGISTRY  # noqa: E402
+from distpow_tpu.runtime.tracing import (  # noqa: E402
+    MemorySink,
+    Tracer,
+    wire_token,
+)
+from distpow_tpu.sched.engine import BatchingScheduler  # noqa: E402
+
+K = int(os.environ.get("SERVING_SMOKE_REQUESTS", "8"))
+NTZ = 3
+BATCH = 1 << 10
+
+
+def persistent_half() -> dict:
+    nonce = b"\xe0\x01\x5a"
+    b0 = REGISTRY.get("search.blocking_syncs")
+    serial = search(nonce, NTZ, list(range(256)), batch_size=BATCH,
+                    launch_candidates=1 << 12)
+    serial_syncs = REGISTRY.get("search.blocking_syncs") - b0
+    b1 = REGISTRY.get("search.blocking_syncs")
+    persistent = persistent_search(nonce, NTZ, list(range(256)),
+                                   batch_size=BATCH,
+                                   launch_candidates=1 << 12)
+    persistent_syncs = REGISTRY.get("search.blocking_syncs") - b1
+    assert serial is not None and persistent is not None
+    if persistent.secret != serial.secret:
+        raise AssertionError(
+            f"parity violation: persistent {persistent.secret.hex()} vs "
+            f"serial {serial.secret.hex()}"
+        )
+    return {
+        "secret": persistent.secret.hex(),
+        "serial_blocking_syncs": serial_syncs,
+        "persistent_blocking_syncs": persistent_syncs,
+        "persistent_steps": REGISTRY.get("search.persistent_steps"),
+    }
+
+
+def mixed_half() -> dict:
+    reqs = [(("sha1" if i % 2 else "md5"), bytes([0xE1, i]))
+            for i in range(K)]
+
+    # per-model solo baseline: same requests, one at a time
+    sl0 = REGISTRY.get("sched.launches")
+    solo_eng = BatchingScheduler(hash_model="md5", batch_size=BATCH,
+                                 max_slots=K, extra_models=("sha1",))
+    try:
+        for m, nonce in reqs:
+            s = solo_eng.search(nonce, NTZ, list(range(256)), hash_model=m)
+            assert puzzle.check_secret(nonce, s, NTZ, m)
+    finally:
+        solo_eng.close()
+    solo_launches = REGISTRY.get("sched.launches") - sl0
+
+    # the batch, through a REAL in-process worker handler
+    tracer = Tracer("serving-smoke", MemorySink())
+    result_queue: "queue.Queue" = queue.Queue()
+    backend = get_backend("jax", batch_size=BATCH)
+    sched = BatchingScheduler(hash_model="md5", batch_size=BATCH,
+                              max_slots=K, extra_models=("sha1",),
+                              fallback=backend, start=False)
+    handler = WorkerRPCHandler(tracer, result_queue, backend,
+                               scheduler=sched)
+    occ0 = REGISTRY.get_histogram("sched.batch_occupancy") or \
+        {"count": 0, "sum": 0.0}
+    mh0 = REGISTRY.get("sched.mixed_hash_launches")
+    sl1 = REGISTRY.get("sched.launches")
+    try:
+        for m, nonce in reqs:
+            trace = tracer.create_trace()
+            handler.Mine({
+                "nonce": nonce, "num_trailing_zeros": NTZ,
+                "worker_byte": 0, "worker_bits": 0,
+                "token": wire_token(trace.generate_token()),
+                "round": None, "hash_model": m,
+            })
+        sched.start()  # all K slots queued: the batch is deterministic
+        by_nonce = dict()
+        deadline = time.time() + 300
+        while len(by_nonce) < K and time.time() < deadline:
+            res = result_queue.get(timeout=120)
+            if res["secret"] is not None:
+                by_nonce[bytes(res["nonce"])] = bytes(res["secret"])
+        for m, nonce in reqs:
+            secret = by_nonce.get(nonce)
+            assert secret is not None, f"no result for {nonce.hex()}"
+            assert puzzle.check_secret(nonce, secret, NTZ, m), \
+                f"{nonce.hex()} secret fails under {m}"
+        batched_launches = REGISTRY.get("sched.launches") - sl1
+        occ1 = REGISTRY.get_histogram("sched.batch_occupancy")
+        n = occ1["count"] - occ0["count"]
+        mean_occ = (occ1["sum"] - occ0["sum"]) / max(n, 1)
+        return {
+            "requests": K,
+            "models": ["md5", "sha1"],
+            "solo_launches": solo_launches,
+            "batched_launches": batched_launches,
+            "mean_occupancy": round(mean_occ, 3),
+            "mixed_hash_launches":
+                REGISTRY.get("sched.mixed_hash_launches") - mh0,
+        }
+    finally:
+        sched.close()
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    persistent = persistent_half()
+    mixed = mixed_half()
+    summary = {
+        "persistent": persistent,
+        "mixed_hash": mixed,
+        "wall_s": round(time.monotonic() - t0, 3),
+    }
+    print(json.dumps(summary))
+    if persistent["persistent_blocking_syncs"] != 0:
+        print("[serving-smoke] FAIL: persistent drain issued blocking "
+              "syncs", file=sys.stderr)
+        return 1
+    if persistent["serial_blocking_syncs"] < 1:
+        print("[serving-smoke] FAIL: serial baseline recorded no "
+              "blocking syncs (instrumentation broken)", file=sys.stderr)
+        return 1
+    if mixed["batched_launches"] >= mixed["solo_launches"]:
+        print(f"[serving-smoke] FAIL: mixed batch spent "
+              f"{mixed['batched_launches']} launches vs "
+              f"{mixed['solo_launches']} solo", file=sys.stderr)
+        return 1
+    if mixed["mean_occupancy"] <= 1 or mixed["mixed_hash_launches"] < 1:
+        print("[serving-smoke] FAIL: no mixed-hash batching observed",
+              file=sys.stderr)
+        return 1
+    print(f"[serving-smoke] OK: {persistent['serial_blocking_syncs']} "
+          f"serial syncs vs 0 persistent; mixed batch "
+          f"{mixed['batched_launches']} launches vs "
+          f"{mixed['solo_launches']} solo, occupancy "
+          f"{mixed['mean_occupancy']}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""Fixture (scope: parallel/): relaunch-loop-sync must flag blocking
+result conversions inside dispatch loops."""
+
+
+def relaunch_loop(step, chunks):
+    results = []
+    chunk0 = 0
+    while chunk0 < chunks:
+        res = step(chunk0)
+        f = int(res)  # line 10: blocking conversion per launch
+        results.append(f)
+        chunk0 += 1
+    return results
+
+
+def drain_vector(step, batches):
+    out = []
+    for res in (step(b) for b in batches):
+        out.append(int(res))  # line 19: conversion inside the for loop
+    return out
+
+
+def drain_lanes(res, n):
+    lanes = []
+    for i in range(n):
+        lanes.append(int(res[i]))  # line 26: subscripted conversion
+    return lanes
+
+
+def drain_comprehension(results):
+    return [int(r) for r in results]  # line 31: comprehension loop

@@ -1,0 +1,24 @@
+"""Fixture (scope: parallel/): shapes relaunch-loop-sync accepts."""
+
+
+def sanctioned_drain(inflight):
+    # the drain helper: the conversion lives OUTSIDE any dispatch loop
+    # (its caller drains one launch per boundary)
+    res = inflight.popleft()
+    return int(res)
+
+
+def dispatch_loop(step, chunks, drain):
+    chunk0 = 0
+    while chunk0 < chunks:
+        res = step(chunk0)
+        drain(res)  # draining through the helper, not converting here
+        chunk0 += int(bool(res is not None))  # int(Call): host arithmetic
+    return chunk0
+
+
+def host_arithmetic(items):
+    total = 0
+    for it in items:
+        total += int(len(repr(it)))  # int over a host call, not a sync
+    return total

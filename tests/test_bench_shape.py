@@ -278,3 +278,89 @@ def test_module_level_is_jax_free(bench):
     src = open(_BENCH).read()
     head = src[: src.index("def device_rate")]
     assert "import jax" not in head
+
+
+SL = {
+    "ntz": 4, "solves": 4,
+    "syncs_per_solve": {"serial": 13.5, "persistent": 0.0},
+    "syncs_reduction_x": 54.0,
+    "launches_per_solve": {"serial": 14.25, "persistent": 14.25},
+    "mixed_hash": {"models": ["md5", "sha1"], "requests": 8,
+                   "solo_launches": 35, "batched_launches": 9,
+                   "mean_occupancy": 3.89, "mixed_hash_launches": 6},
+}
+
+
+def test_finalize_attaches_serving_loop_row(bench):
+    """The serving-loop stage (ISSUE 6) rides both artifacts of a
+    normal run, exactly like the control-plane row."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_FULL, 5.35e6, serving_loop=SL
+    )
+    assert line["serving_loop"] == SL
+    assert prov["serving_loop"] == SL
+    assert line["unit"] == "MH/s"
+
+
+def test_finalize_serving_loop_only_run(bench):
+    """bench.py --serving-loop: the line becomes the syncs-per-solve
+    perf row and kernel provenance is NOT re-stamped."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None, serving_loop=SL)
+    assert prov is None
+    assert line["unit"] == "x"
+    assert line["value"] == 54.0
+    assert line["serving_loop"] == SL
+
+
+def test_finalize_carries_forward_serving_loop(bench):
+    lm = dict(LAST_FULL, serving_loop=SL)
+    line, prov = bench.finalize_record({"serving": 9800.0e6}, lm, 5.35e6)
+    assert prov["serving_loop"] == SL
+    assert "serving_loop" not in line
+
+
+LAST_SUSPECT = dict(
+    LAST_FULL,
+    rates_mhs=dict(LAST_FULL["rates_mhs"], **{"sha3_256-serving": 6.3}),
+    suspect_readings={"sha3_256-serving": {
+        "measured_mhs": 0.85, "last_measured_mhs": 6.3, "ratio": 0.135}},
+)
+
+
+def test_finalize_pending_suspect_rows_stay_annotated(bench):
+    """ISSUE 6: a provenance row whose last reading was screened out
+    must stay visibly suspect — in suspect_readings AND suspect_rows —
+    until a run re-measures it clean, instead of silently carrying the
+    previous value forward."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_SUSPECT, 5.35e6
+    )
+    assert prov["rates_mhs"]["sha3_256-serving"] == 6.3  # carried value
+    assert "sha3_256-serving" in prov["suspect_readings"]
+    assert prov["suspect_rows"] == ["sha3_256-serving"]
+    assert line["suspect_rows"] == ["sha3_256-serving"]
+
+
+def test_finalize_clean_remeasure_clears_suspect_flag(bench):
+    """A clean re-measurement of the suspect stage retires the flag:
+    the fresh value replaces the standing and no annotation remains."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6, "sha3_256-serving": 6.1e6},
+        LAST_SUSPECT, 5.35e6,
+    )
+    assert prov["rates_mhs"]["sha3_256-serving"] == 6.1
+    assert "suspect_readings" not in prov
+    assert "suspect_rows" not in prov and "suspect_rows" not in line
+
+
+def test_finalize_re_suspect_remeasure_keeps_flag(bench):
+    """A re-measurement that the screen rejects AGAIN keeps the row
+    annotated with the fresh context."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6, "sha3_256-serving": 0.9e6},
+        LAST_SUSPECT, 5.35e6,
+    )
+    assert prov["rates_mhs"]["sha3_256-serving"] == 6.3
+    assert prov["suspect_readings"]["sha3_256-serving"]["measured_mhs"] \
+        == 0.9
+    assert prov["suspect_rows"] == ["sha3_256-serving"]

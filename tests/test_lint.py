@@ -101,6 +101,9 @@ CASES = [
      "config_key_sync_ok.py", 3),
     ("hot-path-host-sync", os.path.join("ops", "hot_path_host_sync_bad.py"),
      os.path.join("ops", "hot_path_host_sync_ok.py"), 5),
+    ("relaunch-loop-sync",
+     os.path.join("parallel", "relaunch_loop_sync_bad.py"),
+     os.path.join("parallel", "relaunch_loop_sync_ok.py"), 4),
     ("silent-except", os.path.join("runtime", "silent_except_bad.py"),
      os.path.join("runtime", "silent_except_ok.py"), 3),
     ("bounded-queue", os.path.join("runtime", "bounded_queue_bad.py"),

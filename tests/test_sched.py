@@ -240,6 +240,200 @@ def test_coordinator_process_stays_jax_free():
     )
 
 
+def test_mixed_hash_slots_share_launch_with_parity():
+    """ISSUE-6 mixed-hash acceptance at the engine layer: md5 and sha1
+    slots submitted together must share launches (occupancy mean > 1
+    where single-model-only batching would have been exactly 1 via the
+    solo fallback), record ``sched.mixed_hash_launches``, and each
+    slot's first hit must equal its OWN model's python oracle."""
+    eng = BatchingScheduler(hash_model="md5", batch_size=1 << 10,
+                            max_slots=8, extra_models=("sha1",),
+                            start=False)
+    occ0 = _occupancy_snapshot()
+    mh0 = REGISTRY.get("sched.mixed_hash_launches")
+    launch0 = REGISTRY.get("sched.launches")
+    reqs = [(("sha1" if i % 2 else "md5"), bytes([0x91, i]))
+            for i in range(8)]
+    slots = [eng.submit(nonce, 3, list(range(256)), hash_model=m)
+             for m, nonce in reqs]
+    eng.start()
+    try:
+        for (m, nonce), s in zip(reqs, slots):
+            secret = s.result(timeout=180)
+            oracle = puzzle.python_search(nonce, 3, list(range(256)),
+                                          algo=m)
+            assert secret == oracle, (m, nonce, secret, oracle)
+            assert puzzle.check_secret(nonce, secret, 3, m)
+        conc_launches = REGISTRY.get("sched.launches") - launch0
+        count, total = _hist_delta(occ0)
+        assert count == conc_launches
+        assert total / count > 1, (
+            f"mixed-hash traffic did not batch: mean occupancy "
+            f"{total / count:.2f}"
+        )
+        assert REGISTRY.get("sched.mixed_hash_launches") - mh0 >= 1
+        assert conc_launches < 8 * 2, (
+            "mixed batch spent as many launches as per-model solos"
+        )
+    finally:
+        eng.close()
+
+
+def test_mixed_hash_unadmitted_model_routes_solo_with_parity():
+    """A hash model outside the engine's admitted set must not batch —
+    it serves through the solo route with the REQUESTED model (the
+    wrapped fallback backend's model would be wrong for it)."""
+    eng = BatchingScheduler(hash_model="md5", batch_size=1 << 10,
+                            start=False)
+    try:
+        assert not eng.supports(2, list(range(256)), hash_model="sha1")
+        before = REGISTRY.get("sched.fallback_searches")
+        got = eng.search(b"\x92\x01", 2, list(range(256)),
+                         hash_model="sha1")
+        assert got == puzzle.python_search(b"\x92\x01", 2,
+                                           list(range(256)), algo="sha1")
+        assert REGISTRY.get("sched.fallback_searches") - before == 1
+    finally:
+        eng.close()
+
+
+def test_mixed_hash_impractical_model_never_admitted():
+    """XLA-serving-impractical models stay on the solo route even when
+    configured (on TPU they are served by their Pallas kernels)."""
+    eng = BatchingScheduler(hash_model="md5", batch_size=1 << 10,
+                            extra_models=("sha512",), start=False)
+    try:
+        assert "sha512" not in eng.models
+        assert not eng.supports(2, list(range(256)), hash_model="sha512")
+        # and the solo route refuses it too: the fused XLA step is the
+        # thing that is impractical to compile, so a "fallback" that
+        # runs it anyway would wedge the caller in that compile
+        with pytest.raises(ValueError, match="never admitted"):
+            eng.search(b"\x92\x02", 2, list(range(256)),
+                       hash_model="sha512")
+    finally:
+        eng.close()
+
+
+def test_worker_mine_rpc_honors_hash_model_param():
+    """Worker-level mixed-hash plumbing: a Mine carrying ``hash_model``
+    mines under that model through the scheduler, skips the
+    (single-model) dominance cache, and a worker WITHOUT a scheduler
+    rejects the request instead of mining the wrong hash."""
+    import queue as queue_mod
+
+    from distpow_tpu.backends import get_backend
+    from distpow_tpu.nodes.worker import WorkerRPCHandler
+    from distpow_tpu.runtime.tracing import MemorySink, Tracer, wire_token
+
+    tracer = Tracer("mixed-worker", MemorySink())
+    result_queue: "queue_mod.Queue" = queue_mod.Queue()
+    backend = get_backend("jax", batch_size=1 << 10)
+    sched = BatchingScheduler(hash_model="md5", batch_size=1 << 10,
+                              extra_models=("sha1",), fallback=backend)
+    handler = WorkerRPCHandler(tracer, result_queue, backend,
+                               scheduler=sched)
+    try:
+        def mine(nonce, model):
+            trace = tracer.create_trace()
+            handler.Mine({
+                "nonce": nonce, "num_trailing_zeros": 2,
+                "worker_byte": 0, "worker_bits": 0,
+                "token": wire_token(trace.generate_token()),
+                "round": None, "hash_model": model,
+            })
+
+        mine(b"\xa1\x01", "sha1")
+        res = result_queue.get(timeout=120)
+        assert res["secret"] is not None
+        assert puzzle.check_secret(res["nonce"], res["secret"], 2, "sha1")
+        # the sha1 secret must NOT have entered the md5 dominance cache
+        assert handler.result_cache.satisfies(b"\xa1\x01", 2) is None
+        # and the forwarded result is TAGGED off-model so the
+        # coordinator's single-model cache skips it too
+        assert res["hash_model"] == "sha1"
+    finally:
+        sched.close()
+
+    no_sched = WorkerRPCHandler(tracer, result_queue, backend)
+    trace = tracer.create_trace()
+    with pytest.raises(RuntimeError, match="mixed-hash"):
+        no_sched.Mine({
+            "nonce": b"\xa1\x02", "num_trailing_zeros": 2,
+            "worker_byte": 0, "worker_bits": 0,
+            "token": wire_token(trace.generate_token()),
+            "round": None, "hash_model": "sha1",
+        })
+
+
+def test_coordinator_result_skips_cache_for_off_model_results():
+    """A worker-tagged off-model Result must never install into the
+    coordinator's single-model dominance cache: a later default-model
+    Mine for a dominated (nonce, ntz) would replay a secret that fails
+    default-model verification."""
+    from distpow_tpu.nodes.coordinator import CoordRPCHandler
+    from distpow_tpu.runtime.tracing import MemorySink, Tracer, wire_token
+
+    tracer = Tracer("coord-offmodel", MemorySink())
+    coord = CoordRPCHandler(tracer, ["127.0.0.1:1"])  # never dialed
+    sha1_secret = puzzle.python_search(b"\xb3\x01", 2, list(range(256)),
+                                       algo="sha1")
+
+    def result(nonce, secret, **extra):
+        trace = tracer.create_trace()
+        coord.Result({
+            "nonce": nonce, "num_trailing_zeros": 2, "worker_byte": 0,
+            "secret": secret, "round": None,
+            "token": wire_token(trace.generate_token()), **extra,
+        })
+
+    result(b"\xb3\x01", sha1_secret, hash_model="sha1")
+    assert coord.result_cache.satisfies(b"\xb3\x01", 2) is None
+    # an untagged (default-model) result still installs
+    md5_secret = puzzle.python_search(b"\xb3\x02", 2, list(range(256)))
+    result(b"\xb3\x02", md5_secret)
+    assert coord.result_cache.satisfies(b"\xb3\x02", 2) is not None
+
+
+def test_worker_mine_rpc_rejects_unservable_models_at_rpc():
+    """An unknown or never-admitted hash model on a SCHEDULER worker
+    must fail the Mine RPC itself: raising later inside the daemon
+    miner thread would produce no result, no cancel acks and no error
+    reply — the caller would wait out its full timeout instead of
+    getting the honest refusal a scheduler-less worker already sends."""
+    import queue as queue_mod
+
+    from distpow_tpu.backends import get_backend
+    from distpow_tpu.nodes.worker import WorkerRPCHandler
+    from distpow_tpu.runtime.tracing import MemorySink, Tracer, wire_token
+
+    tracer = Tracer("mixed-worker-reject", MemorySink())
+    result_queue: "queue_mod.Queue" = queue_mod.Queue()
+    backend = get_backend("jax", batch_size=1 << 10)
+    sched = BatchingScheduler(hash_model="md5", batch_size=1 << 10,
+                              fallback=backend, start=False)
+    handler = WorkerRPCHandler(tracer, result_queue, backend,
+                               scheduler=sched)
+    try:
+        def mine(nonce, model):
+            trace = tracer.create_trace()
+            handler.Mine({
+                "nonce": nonce, "num_trailing_zeros": 2,
+                "worker_byte": 0, "worker_bits": 0,
+                "token": wire_token(trace.generate_token()),
+                "round": None, "hash_model": model,
+            })
+
+        with pytest.raises(RuntimeError, match="unknown hash_model"):
+            mine(b"\xa2\x01", "sha-1")
+        with pytest.raises(RuntimeError, match="never admitted"):
+            mine(b"\xa2\x02", "sha512")
+        # neither refusal may leave a registered task behind
+        assert handler._tasks == {}
+    finally:
+        sched.close()
+
+
 def test_engine_close_unblocks_waiters():
     eng = BatchingScheduler(hash_model="md5", batch_size=1 << 10,
                             start=False)
