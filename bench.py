@@ -30,12 +30,15 @@ tail, never the registry's standing —
      their last measured rate, sha512/sha384 skipped outright
      (compile-impractical, docs/KERNELS.md) — deadline-gated
 
-Two CPU-only stages ride after the device phases (and standalone via
-``--control-plane`` / ``--serving-loop``, plus automatically on
-device-unreachable runs): the RPC control-plane latency stage (ISSUE 5)
-and the serving-loop stage (ISSUE 6: blocking host syncs per solve,
-serial vs persistent driver, plus mixed-hash batching occupancy) — the
-perf rows that keep moving while the tunnel is down.
+Three CPU-only stages ride after the device phases (and standalone via
+``--control-plane`` / ``--serving-loop`` / ``--load-slo``, plus
+automatically on device-unreachable runs): the RPC control-plane
+latency stage (ISSUE 5), the serving-loop stage (ISSUE 6: blocking
+host syncs per solve, serial vs persistent driver, plus mixed-hash
+batching occupancy), and the open-loop load + cluster-SLO stage
+(ISSUE 8: achieved solves/s and cluster-merged p95 under seeded
+Poisson traffic, judged against config/slo.json) — the perf rows that
+keep moving while the tunnel is down.
 
 Every reading is screened against ``last_measured.json``: a rate
 deviating more than 3x from the previous measurement of the same stage
@@ -135,7 +138,8 @@ def screen_rates(measured_mhs: dict, last_measured: dict | None,
 def finalize_record(rates_hs: dict, last_measured: dict | None,
                     baseline_hs: float | None, note: str | None = None,
                     control_plane: dict | None = None,
-                    serving_loop: dict | None = None):
+                    serving_loop: dict | None = None,
+                    load_slo: dict | None = None):
     """Build the stdout JSON line and the provenance record, once.
 
     Shared by the success path and the hang bailout (review r5: two
@@ -183,6 +187,29 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
     all_suspect.update(suspect)
     md5_acc = {l: v for l, v in accepted.items() if l in MD5_LABELS}
     if not md5_acc:
+        if load_slo and not control_plane and not serving_loop:
+            # a load-slo-only run (bench.py --load-slo): the third
+            # tunnel-independent perf row (ISSUE 8) — open-loop achieved
+            # solves/s at the highest offered rate with the cluster-
+            # merged SLO asserted.  Kernel provenance stays untouched
+            # (prov None), like the other CPU-only shapes below.
+            rows = load_slo.get("rates") or {}
+            top = max(rows.values(), key=lambda r: r.get("target_hz", 0.0),
+                      default={})
+            line = {
+                "metric": (
+                    "open-loop load harness achieved solves/s at "
+                    f"{top.get('target_hz', 0.0):g} req/s offered, "
+                    "cluster-merged SLO asserted "
+                    "(CPU, tunnel-independent)"),
+                "value": top.get("achieved_solves_per_s", 0.0),
+                "unit": "solves/s",
+                "vs_baseline": 0.0,
+                "load_slo": load_slo,
+            }
+            if note:
+                line["note"] = note
+            return line, None
         if serving_loop and not control_plane:
             # a serving-loop-only run (bench.py --serving-loop): the
             # other tunnel-independent perf row — blocking host syncs
@@ -197,6 +224,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 "vs_baseline": 0.0,
                 "serving_loop": serving_loop,
             }
+            if load_slo:
+                line["load_slo"] = load_slo
             if note:
                 line["note"] = note
             return line, None
@@ -223,6 +252,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
             }
             if serving_loop:
                 line["serving_loop"] = serving_loop
+            if load_slo:
+                line["load_slo"] = load_slo
             if note:
                 line["note"] = note
             return line, None
@@ -321,6 +352,11 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
         prov["serving_loop"] = serving_loop
     elif (last_measured or {}).get("serving_loop"):
         prov["serving_loop"] = last_measured["serving_loop"]
+    if load_slo:
+        line["load_slo"] = load_slo
+        prov["load_slo"] = load_slo
+    elif (last_measured or {}).get("load_slo"):
+        prov["load_slo"] = last_measured["load_slo"]
     return line, prov
 
 
@@ -768,6 +804,71 @@ def control_plane_stage(ns=(2, 8, 32), rounds=8, delay_ms=40.0) -> dict:
     return out
 
 
+def load_slo_stage(rates=(6.0, 12.0), duration_s=5.0) -> dict:
+    """Open-loop load + cluster SLO stage (``--load-slo``): CPU-only,
+    zero tunnel dependence (ISSUE 8, ROADMAP open item 5b).
+
+    For each offered arrival rate, replays a seeded Poisson mix with
+    Zipf key skew (so the dominance cache and the PR 4 coalescer carry
+    their production share of the traffic) against a fresh in-process
+    python-backend cluster, while the fleet scraper
+    (distpow_tpu/obs/) sweeps the nodes' Stats RPCs and the SLO engine
+    judges the merged run window against the checked-in
+    ``config/slo.json``.  Reports achieved solves/s and cluster-merged
+    Mine p95 per rate; the merged percentile is cross-checked against
+    the coordinator's own single-node estimate within one histogram
+    bucket (the merge may re-bucket, never relocate — docs/SLO.md).
+    """
+    from distpow_tpu.load import LoadMix, run_load_slo
+
+    stage_t0 = time.time()
+    slo_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "config", "slo.json")
+    out: dict = {"slo_config": "config/slo.json",
+                 "duration_s": duration_s, "rates": {}, "ok": True}
+    for i, rate in enumerate(sorted(rates)):
+        mix = LoadMix(
+            rate_hz=float(rate), duration_s=float(duration_s),
+            seed=41 + i,  # disjoint nonce universes per rate: no
+            # cross-rate dominance-cache hits polluting the measurement
+            n_keys=16, zipf_s=1.1,
+            difficulties=((1, 0.6), (2, 0.4)),
+        )
+        report, verdict = run_load_slo(
+            mix, slo_path, n_workers=2,
+            include_worker_targets=True, scrape_interval_s=0.5,
+        )
+        oracle = report.get("oracle_check") or {}
+        row = {
+            "target_hz": float(rate),
+            "issued": report["load"]["issued"],
+            "completed": report["completed"],
+            "achieved_solves_per_s": report["achieved_solves_per_s"],
+            "client_p95_ms": report["client_latency_ms"]["p95"],
+            "merged_miss_p95_ms": report["merged"]["mine_miss_p95_ms"],
+            "cache_hits": report["merged"]["cache_hits"],
+            "coalesced": report["merged"]["coalesced_requests"],
+            "request_errors": report["request_errors"],
+            "verdict": verdict.status,
+            "oracle_within_bucket": bool(oracle.get("ok")),
+            "oracle": oracle,
+        }
+        out["rates"][f"r{int(rate)}"] = row
+        if verdict.exit_code() != 0 or not row["oracle_within_bucket"] \
+                or report["request_errors"]:
+            out["ok"] = False
+        print(f"[bench] load-slo rate {rate}/s: "
+              f"{row['achieved_solves_per_s']} solves/s achieved, "
+              f"merged miss p95 {row['merged_miss_p95_ms']} ms "
+              f"(oracle ok={row['oracle_within_bucket']}), "
+              f"verdict {verdict.status}", file=sys.stderr)
+    out["wall_s"] = round(time.time() - stage_t0, 1)
+    if not out["ok"]:
+        print("[bench] WARNING: load-slo stage did not meet its "
+              "green-config/oracle acceptance", file=sys.stderr)
+    return out
+
+
 def serving_stage(ks=(1, 4, 16)) -> dict:
     """Aggregate serving throughput under concurrency (``--serving``).
 
@@ -1094,6 +1195,17 @@ def main() -> None:
                                   control_plane=cp)
         print(json.dumps(line))
         return
+    if "--load-slo" in sys.argv:
+        # standalone open-loop load + SLO run (ISSUE 8): CPU-only by
+        # construction — python-backend workers, localhost RPC, no jax
+        # and no device probe — so it survives any tunnel state; the
+        # line rides finalize_record's load-slo shape and kernel
+        # provenance stays untouched (docstring there)
+        ls = load_slo_stage()
+        line, _ = finalize_record({}, _read_last_measured(), None,
+                                  load_slo=ls)
+        print(json.dumps(line))
+        return
     if not _device_alive():
         line = {
             "metric": "MH/s/chip md5 pow search (device unreachable)",
@@ -1112,6 +1224,17 @@ def main() -> None:
                 line["metric"] += "; control-plane stage measured on CPU"
             except Exception as exc:
                 print(f"[bench] control-plane stage failed: {exc}",
+                      file=sys.stderr)
+        if os.environ.get("BENCH_LOAD_SLO") != "0":
+            # third tunnel-independent row (ISSUE 8): open-loop load +
+            # cluster SLO on python backends — like the control-plane
+            # stage it never touches jax, so a hung tunnel cannot
+            # reach it
+            try:
+                line["load_slo"] = load_slo_stage()
+                line["metric"] += "; load-slo stage measured on CPU"
+            except Exception as exc:
+                print(f"[bench] load-slo stage failed: {exc}",
                       file=sys.stderr)
         if os.environ.get("BENCH_SERVING_LOOP") != "0":
             # same rationale for the serving-loop row (ISSUE 6), but
@@ -1563,10 +1686,25 @@ def main() -> None:
             timeout_s=min(600.0, max(1.0, deadline - time.time()))
         )
 
+    # ---- Load-SLO stage (CPU, deadline-gated) ------------------------
+    # the open-loop + cluster-SLO row (ISSUE 8): python backends only —
+    # like the control-plane stage it never touches jax, so it runs on
+    # healthy rounds too (a row measured only on device-unreachable
+    # rounds would carry forward stale the moment the tunnel recovers)
+    load_slo = None
+    if os.environ.get("BENCH_LOAD_SLO") != "0" and \
+            time.time() <= deadline:
+        try:
+            load_slo = load_slo_stage()
+        except Exception as exc:
+            print(f"[bench] load-slo stage failed: {exc}",
+                  file=sys.stderr)
+
     # ---- Final line ---------------------------------------------------
     line, prov = finalize_record(rates, last_measured, baseline,
                                  control_plane=control_plane,
-                                 serving_loop=serving_loop)
+                                 serving_loop=serving_loop,
+                                 load_slo=load_slo)
     # the measured roofline rides in provenance: the generated
     # registry-standing table (scripts/gen_registry_table.py) derives
     # utilization percentages from it.  prov is None when no md5 stage

@@ -11,6 +11,14 @@ shared deadline (nodes/coordinator.py ``_assign_shards`` /
 ``.call`` loop reintroduced in ``nodes/`` is a lint failure, not a
 latency regression someone has to re-measure on hardware.
 
+The fleet scraper (``distpow_tpu/obs/``, ISSUE 8) is the same bug
+class one layer up: a sweep that Stats-polls N nodes one after another
+serializes the cluster view on round trips and lets one SIGSTOP'd node
+stall the whole sweep for its timeout — exactly what the shared-
+deadline concurrent poll exists to prevent (docs/SLO.md).  The rule
+therefore covers ``obs/`` with the same detection and the same
+suppression protocol.
+
 Detection is lexical, like the sibling rules: a ``for`` loop whose
 iterated expression mentions a worker/peer-collection name (any
 identifier containing ``worker``, ``peer``, ``task``, ``ref``,
@@ -32,12 +40,14 @@ from ._util import in_dirs, receiver_name, walk_same_scope
 
 RULE_ID = "serial-rpc-fanout"
 DESCRIPTION = (
-    "no blocking .call() per peer inside a loop over worker/peer "
-    "collections in nodes/ — issue go() futures, then await"
+    "no blocking .call() per peer inside a loop over worker/peer/node "
+    "collections in nodes/ or obs/ — issue go() futures, then await"
 )
 
 #: identifiers that mark a loop as iterating a peer collection
-COLLECTION_HINTS = ("worker", "peer", "task", "ref", "client", "addr")
+#: (``target``/``node``/``state`` cover the obs/ scraper's vocabulary)
+COLLECTION_HINTS = ("worker", "peer", "task", "ref", "client", "addr",
+                    "target", "node", "state")
 
 #: receivers whose .call is not an RPC
 EXCLUDED_RECEIVERS = frozenset({"subprocess"})
@@ -55,7 +65,7 @@ def _iter_mentions_peers(iter_expr: ast.AST) -> bool:
 
 
 def check(module, context) -> Iterator:
-    if not in_dirs(module.path, "nodes"):
+    if not in_dirs(module.path, "nodes", "obs"):
         return
     for node in ast.walk(module.tree):
         if not isinstance(node, ast.For):

@@ -10,7 +10,8 @@ mode) fall back to the fused XLA step transparently.
 
 from __future__ import annotations
 
-from typing import Optional
+import math
+from typing import Optional, Tuple
 
 from ..models.registry import get_hash_model
 from ..ops.md5_pallas import (
@@ -21,6 +22,75 @@ from ..ops.md5_pallas import (
 from ..ops.search_step import cached_search_step
 from ..parallel.partition import contiguous_bounds
 from ..parallel.search import search
+
+
+def plan_launch_geometry(target_chunks: int, tbc: int, tile: int,
+                         inner: int, launch_steps: int,
+                         max_launch: int) -> Tuple[int, int, int]:
+    """Pick the kernel launch geometry ``(batch, chunks, k)`` for one
+    dispatch — pure math, extracted so the k-selection rules are unit-
+    testable on CPU (ISSUE 8 satellite; the advisor-r5 pow2-k fix lives
+    here).
+
+    * the batch rounds UP to a whole number of ``tile``-sized grids and
+      ``k`` (the launch multiplier) re-clamps to the rounded batch so
+      ``batch * k`` stays within ``max_launch``;
+    * for non-power-of-two tiles with ``inner > 1``, ``k`` is rounded
+      down to a power of two ONLY when that keeps (or makes, after a
+      marginal <=2% whole-tile batch growth) the inner loop effective —
+      when the growth conditions fail, the ORIGINAL k is kept: rounding
+      unconditionally cost non-pow2 tiles with a non-pow2 multiplier up
+      to ~2x launch amortization for nothing (advisor r5 low #1).
+    """
+    chunks = max(1, target_chunks)
+    batch = chunks * tbc
+    # round the batch up to a whole tile grid
+    if batch % tile:
+        batch = ((batch // tile) + 1) * tile
+        chunks = max(1, batch // tbc)
+    # re-clamp the launch multiplier to the ROUNDED batch: the driver
+    # computed launch_steps for the unrounded one, and rounded_batch * k
+    # must stay within the uint32 flat-index bound (_check_launch) and
+    # the dispatch budget
+    k = max(1, min(launch_steps, max_launch // batch))
+    # keep the tuned inner effective for non-power-of-two tiles (the
+    # sweep-best sublanes=24 geometries): the kernel shrinks inner until
+    # it divides the per-dispatch tile count, and a 24-sublane tile
+    # leaves 2^21 candidates at 683 tiles — prime — so with an odd
+    # launch multiplier the inner loop collapses all the way to 1 (the
+    # review-r4 trap that kept those geometries unshippable).  Two
+    # bounded moves fix it: round k down to a power of two (so the
+    # dispatch tile count carries pow2 factors), then grow the batch by
+    # whole tiles until k*n_tiles divides inner — but ONLY when the
+    # growth is marginal (<=2%) and the k clamp is unaffected; otherwise
+    # keep the old shrink-inner behavior (review r5: an uncapped version
+    # of this grew small width segments 4x and blew the dispatch budget
+    # the k clamp above enforces).  For all-power-of-two geometries
+    # every condition already holds: no-op.
+    if inner > 1 and (tile & (tile - 1)):
+        # the pow2 rounding only commits together with a batch that
+        # makes inner effective; when the growth conditions fail, the
+        # ORIGINAL k is kept (shrink-inner behavior) — advisor r5 low #1
+        k2 = 1 << (k.bit_length() - 1)
+        need = inner // math.gcd(k2, inner)
+        n = batch // tile
+        if n % need == 0:
+            k = k2
+        else:
+            cap = batch + max(tile, batch // 50)
+            grown = n + (need - n % need)
+            while grown * tile <= cap and (grown * tile) % tbc:
+                grown += need
+            gbatch = grown * tile
+            # the k in use must still fit the budget at the grown batch
+            # (compare in pow2-rounded space)
+            reclamp = max(1, min(launch_steps, max_launch // gbatch))
+            if (gbatch <= cap and gbatch % tbc == 0
+                    and 1 << (reclamp.bit_length() - 1) >= k2):
+                batch = gbatch
+                chunks = max(1, batch // tbc)
+                k = k2
+    return batch, chunks, k
 
 
 class PallasBackend:
@@ -63,62 +133,10 @@ class PallasBackend:
                     ),
                     1,
                 )
-            chunks = max(1, target_chunks)
-            batch = chunks * tbc
-            # round the batch up to a whole tile grid
-            if batch % tile:
-                batch = ((batch // tile) + 1) * tile
-                chunks = max(1, batch // tbc)
-            # re-clamp the launch multiplier to the ROUNDED batch: the
-            # driver computed launch_steps for the unrounded one, and
-            # rounded_batch * k must stay within the uint32 flat-index
-            # bound (_check_launch) and the dispatch budget
-            k = max(1, min(launch_steps, self.max_launch // batch))
-            # keep the tuned inner effective for non-power-of-two tiles
-            # (the sweep-best sublanes=24 geometries): the kernel
-            # shrinks inner until it divides the per-dispatch tile
-            # count, and a 24-sublane tile leaves 2^21 candidates at
-            # 683 tiles — prime — so with an odd launch multiplier the
-            # inner loop collapses all the way to 1 (the review-r4 trap
-            # that kept those geometries unshippable).  Two bounded
-            # moves fix it: round k down to a power of two (so the
-            # dispatch tile count carries pow2 factors), then grow the
-            # batch by whole tiles until k*n_tiles divides inner —
-            # but ONLY when the growth is marginal (<=2%) and the k
-            # clamp is unaffected; otherwise keep the old
-            # shrink-inner behavior (review r5: an uncapped version of
-            # this grew small width segments 4x and blew the dispatch
-            # budget the k clamp above enforces).  For all-power-of-two
-            # geometries every condition already holds: no-op.
-            if self.inner > 1 and (tile & (tile - 1)):
-                import math
-
-                # the pow2 rounding only commits together with a batch
-                # that makes inner effective; when the growth conditions
-                # fail, the ORIGINAL k is kept (shrink-inner behavior) —
-                # rounding unconditionally cost non-pow2 tiles with a
-                # non-pow2 multiplier up to ~2x launch amortization for
-                # nothing (advisor r5 low #1)
-                k2 = 1 << (k.bit_length() - 1)
-                need = self.inner // math.gcd(k2, self.inner)
-                n = batch // tile
-                if n % need == 0:
-                    k = k2
-                else:
-                    cap = batch + max(tile, batch // 50)
-                    grown = n + (need - n % need)
-                    while grown * tile <= cap and (grown * tile) % tbc:
-                        grown += need
-                    gbatch = grown * tile
-                    # the k in use must still fit the budget at the
-                    # grown batch (compare in pow2-rounded space)
-                    reclamp = max(1, min(launch_steps,
-                                         self.max_launch // gbatch))
-                    if (gbatch <= cap and gbatch % tbc == 0
-                            and 1 << (reclamp.bit_length() - 1) >= k2):
-                        batch = gbatch
-                        chunks = max(1, batch // tbc)
-                        k = k2
+            batch, chunks, k = plan_launch_geometry(
+                target_chunks, tbc, tile, self.inner, launch_steps,
+                self.max_launch,
+            )
             try:
                 # launch_steps just extends the kernel's sequential grid
                 # (ops/md5_pallas.py), so the kernel serves the big

@@ -3,11 +3,25 @@ lists metrics as absent in the reference).
 
     python -m distpow_tpu.cli.stats --addr HOST:PORT
         [--role auto|coordinator|worker] [--prom] [--watch SECS [--count N]]
+    python -m distpow_tpu.cli.stats --cluster --addr A [--addr B ...]
+        [--deadline SECS] [--prom]
 
 Dials the node's RPC port, calls its ``Stats`` method, and prints the
-JSON snapshot.  ``--role auto`` (default) tries the coordinator service
-name first, then the worker's.  For a coordinator, use the CLIENT-facing
-listen address.
+JSON snapshot.  ``--role auto`` (default) tries the role-agnostic
+``Node.Stats`` alias first (every current node answers it without
+minting a handler error), falling back to the coordinator's then the
+worker's service name for pre-alias nodes.  For a coordinator, use the
+CLIENT-facing listen address.
+
+``--cluster`` accepts MULTIPLE ``--addr`` flags (each may also be a
+comma-separated list), polls every node's Stats concurrently under one
+shared ``--deadline``, and prints the bucket-wise MERGED cluster
+snapshot (distpow_tpu/obs/, docs/SLO.md): summed counters/gauges,
+merged histograms with cluster percentiles, per-node status — a node
+that fails to answer in time is reported ``stale`` with its last-seen
+age, never waited for.  With ``--prom`` the merged series are emitted
+cluster-labelled (``distpow_node_info{node=...}`` /
+``distpow_node_stale{node=...}`` per node rides alongside).
 
 ``--prom`` renders the snapshot as Prometheus text exposition (version
 0.0.4): counters/gauges become ``distpow_<name>`` samples and every
@@ -32,11 +46,13 @@ from ..runtime.rpc import RPCClient, RPCError
 
 
 def fetch_stats(addr: str, role: str = "auto", timeout: float = 5.0) -> dict:
-    services = {
-        "coordinator": ["CoordRPCHandler.Stats"],
-        "worker": ["WorkerRPCHandler.Stats"],
-        "auto": ["CoordRPCHandler.Stats", "WorkerRPCHandler.Stats"],
-    }[role]
+    # ONE role->service table for every observability consumer: the
+    # fleet scraper owns it (obs/scrape.py _SERVICES — auto tries the
+    # role-agnostic Node.Stats alias first); duplicating it here is how
+    # the CLI and the scraper would drift apart
+    from ..obs.scrape import _SERVICES
+
+    services = _SERVICES[role]
     # pinned to the JSON floor codec: this diagnostic dials a FRESH
     # connection per fetch (watch mode rides out restarts that way), and
     # a per-poll rpc.hello would tick the observed node's negotiation
@@ -107,6 +123,35 @@ def render_prometheus(snap: dict) -> str:
     return "\n".join(out) + "\n"
 
 
+def render_cluster_prometheus(cluster: dict) -> str:
+    """Merged cluster snapshot -> Prometheus text exposition.
+
+    The merged counters/gauges/histograms render through the same
+    single-node path (they share its snapshot shape) under
+    ``role="cluster"``; per-node membership, staleness, and last-seen
+    age ride as labelled gauges so one scrape shows both the cluster
+    view and which nodes it is missing."""
+    body = render_prometheus(dict(cluster, role="cluster"))
+    out = [body.rstrip("\n")]
+    per_node = cluster.get("per_node") or {}
+    if per_node:
+        out.append("# HELP distpow_node_stale node missed the sweep "
+                   "deadline (1) or answered (0)")
+        out.append("# TYPE distpow_node_stale gauge")
+        for name, meta in sorted(per_node.items()):
+            role = meta.get("role", "unknown")
+            out.append(
+                f'distpow_node_info{{role="{role}",node="{name}"}} 1')
+            stale = 1 if meta.get("status") == "stale" else 0
+            out.append(f'distpow_node_stale{{node="{name}"}} {stale}')
+            age = meta.get("age_s")
+            if age is not None:
+                out.append(
+                    f'distpow_node_age_seconds{{node="{name}"}} '
+                    f"{_prom_num(age)}")
+    return "\n".join(out) + "\n"
+
+
 def _fmt_quantiles(h: dict) -> str:
     def f(v):
         return "-" if v is None else f"{v:.4g}"
@@ -139,7 +184,9 @@ def render_watch_delta(prev: dict, snap: dict) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="print a distpow node's metrics")
-    ap.add_argument("--addr", required=True, help="node RPC address host:port")
+    ap.add_argument("--addr", required=True, action="append",
+                    help="node RPC address host:port (repeatable with "
+                         "--cluster; each flag may hold a comma list)")
     ap.add_argument("--role", choices=["auto", "coordinator", "worker"],
                     default="auto")
     ap.add_argument("--timeout", type=float, default=5.0)
@@ -149,9 +196,35 @@ def main(argv=None) -> int:
                     help="refresh every SECS seconds, printing deltas")
     ap.add_argument("--count", type=int, default=0,
                     help="with --watch: stop after N refreshes (0 = forever)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="scrape every --addr concurrently and print the "
+                         "merged cluster snapshot (docs/SLO.md)")
+    ap.add_argument("--deadline", type=float, default=5.0,
+                    help="with --cluster: shared sweep deadline in seconds"
+                         " — slower nodes are reported stale, not waited on")
     args = ap.parse_args(argv)
+    addrs = [a for flag in args.addr for a in flag.split(",") if a]
     if args.watch is not None and args.watch <= 0:
         ap.error("--watch SECS must be positive")
+    if args.cluster:
+        if args.watch is not None:
+            ap.error("--cluster does not support --watch")
+        from ..obs.scrape import scrape_cluster
+
+        cluster = scrape_cluster(addrs, deadline_s=args.deadline,
+                                 role=args.role)
+        text = render_cluster_prometheus(cluster) if args.prom \
+            else json.dumps(cluster, indent=2, sort_keys=True)
+        try:
+            print(text, flush=True)
+        except BrokenPipeError:
+            return 0
+        # partial visibility is an error signal for scripts: a sweep
+        # that lost nodes exits 1 even though it printed what it saw
+        return 1 if cluster.get("stale_nodes") else 0
+    if len(addrs) != 1:
+        ap.error("multiple --addr values require --cluster")
+    args.addr = addrs[0]
 
     try:
         prev: dict = {}

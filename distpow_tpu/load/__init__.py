@@ -1,0 +1,36 @@
+"""Open-loop load harness (ISSUE 8; ROADMAP open item 5b; docs/SLO.md).
+
+The "millions of users" north star needs a traffic source that behaves
+like users, not like a benchmark loop: OPEN-LOOP arrivals (requests
+fire on a seeded Poisson schedule regardless of how many are still in
+flight — a slow server faces a growing backlog, exactly like
+production) with Zipf-skewed keys (so the dominance cache and the PR 4
+coalescer see the repeat traffic they were built for), blended
+difficulties, and optional PR 1 fault-plane chaos.
+
+* :mod:`.loadgen`  — the seeded schedule builder + open-loop runner
+  (deterministic: one seed, one schedule — replayable in CI);
+* :mod:`.harness`  — an in-process cluster wired to the fleet scraper
+  and SLO engine (distpow_tpu/obs/): run a mix, scrape the nodes,
+  assert the objectives.  ``bench.py --load-slo`` and
+  ``scripts/ci.sh --slo-smoke`` are thin wrappers over this.
+"""
+
+from .loadgen import Arrival, LoadMix, OpenLoopRunner, build_schedule
+from .harness import (
+    InProcCluster,
+    exact_percentile,
+    percentile_within_one_bucket,
+    run_load_slo,
+)
+
+__all__ = [
+    "Arrival",
+    "LoadMix",
+    "OpenLoopRunner",
+    "build_schedule",
+    "InProcCluster",
+    "exact_percentile",
+    "percentile_within_one_bucket",
+    "run_load_slo",
+]

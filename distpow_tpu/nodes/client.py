@@ -47,10 +47,17 @@ class Client:
         self._initialized = True
         return self.notify_queue
 
-    def mine(self, nonce: bytes, num_trailing_zeros: int) -> None:
+    def mine(self, nonce: bytes, num_trailing_zeros: int,
+             hash_model: Optional[str] = None) -> None:
+        """``hash_model`` (optional, docs/SERVING.md): request an
+        off-default hash model end to end — powlib tags the Mine, the
+        coordinator routes it cache-skipped to model-capable workers.
+        None keeps the request wire-identical to every earlier
+        version."""
         if not self._initialized:
             raise RuntimeError("client not initialized")
-        self.pow.mine(self.tracer, nonce, num_trailing_zeros)
+        self.pow.mine(self.tracer, nonce, num_trailing_zeros,
+                      hash_model=hash_model)
 
     def close(self) -> None:
         # powlib first: it joins in-flight mine threads, which may still

@@ -82,7 +82,13 @@ from ..runtime import actions as act
 from ..runtime.cache import ResultCache
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import CoordinatorConfig
-from ..runtime.rpc import RPCClient, RPCError, RPCServer, RPCTransportError
+from ..runtime.rpc import (
+    RPCClient,
+    RPCError,
+    RPCServer,
+    RPCTransportError,
+    StatsOnly,
+)
 from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, make_tracer, wire_token
 from ..sched.admission import AdmissionReject
@@ -374,7 +380,7 @@ class CoordRPCHandler:
         return [(w, s) for w, s in tasks if id(w) not in dead_ids], orphans
 
     def _issue_shards(self, trace, nonce: bytes, ntz: int, tasks, shards,
-                      rid: str):
+                      rid: str, model: Optional[str] = None):
         """Place each shard on some live worker; shards that cannot be
         placed right now stay pending for the next probe round (coverage
         is never silently dropped)."""
@@ -388,7 +394,8 @@ class CoordRPCHandler:
                 if not candidates:
                     break
                 w = candidates[i % len(candidates)]
-                placed = self._send_mine(trace, nonce, ntz, w, shard, rid)
+                placed = self._send_mine(trace, nonce, ntz, w, shard, rid,
+                                         model)
                 # a failed send marked w dead; retry the rest
             if placed:
                 tasks.append((w, shard))
@@ -404,12 +411,19 @@ class CoordRPCHandler:
         metrics.inc("coord.mine_rpcs")
         nonce = bytes(params["nonce"])
         ntz = int(params["num_trailing_zeros"])
+        # off-default hash model (docs/SERVING.md): forwarded to the
+        # workers (whose Mine validates it against their serving set)
+        # and excluded from the SINGLE-MODEL dominance cache on both
+        # lookup and install — a cached default-model secret replayed
+        # against another hash would fail verification.  None/"" keeps
+        # every frame and every code path identical to plain traffic.
+        model = params.get("hash_model") or None
         trace = self.tracer.receive_token(decode_token(params["token"]))
         trace.record_action(
             act.CoordinatorMine(nonce=nonce, num_trailing_zeros=ntz)
         )
 
-        cached = self.result_cache.get(nonce, ntz, trace)
+        cached = None if model else self.result_cache.get(nonce, ntz, trace)
         if cached is not None:
             metrics.observe("coord.mine_s.hit", time.monotonic() - t0)
             return self._success_reply(trace, nonce, ntz, cached)
@@ -425,7 +439,13 @@ class CoordRPCHandler:
                 # the live round as a waiter — one fan-out, N replies
                 metrics.inc("sched.coalesced_requests")
                 handle.wait()
-                cached = self.result_cache.get(nonce, ntz, trace)
+                # an off-model waiter cannot be served from the leader's
+                # (default-model) cache entry: skip the lookup and lead
+                # its own round on the next pass.  The coalescer stays
+                # keyed by (nonce, ntz) alone, so different-model
+                # duplicates SERIALIZE rather than share a result.
+                cached = None if model else self.result_cache.get(
+                    nonce, ntz, trace)
                 if cached is not None:
                     # same split rule as the key-lock era: a duplicate
                     # that waited out the leader's round is a hit
@@ -449,14 +469,15 @@ class CoordRPCHandler:
                 # fix; with coalescing on, only round leaders ever
                 # contend here)
                 with self._key_lock(key):
-                    cached = self.result_cache.get(nonce, ntz, trace)
+                    cached = None if model else self.result_cache.get(
+                        nonce, ntz, trace)
                     if cached is not None:
                         metrics.observe("coord.mine_s.hit",
                                         time.monotonic() - t0)
                         return self._success_reply(trace, nonce, ntz, cached)
                     reserved = self._admit(nonce, ntz)
                     try:
-                        return self._mine_miss(trace, nonce, ntz)
+                        return self._mine_miss(trace, nonce, ntz, model)
                     finally:
                         if reserved:
                             with self._tasks_lock:
@@ -518,8 +539,8 @@ class CoordRPCHandler:
         return w.client.go(method, params)
 
     def _mine_params(self, trace, nonce: bytes, ntz: int, worker_byte: int,
-                     rid: str) -> dict:
-        return {
+                     rid: str, model: Optional[str] = None) -> dict:
+        out = {
             "nonce": bytes(nonce),
             "num_trailing_zeros": ntz,
             "worker_byte": worker_byte,
@@ -527,10 +548,16 @@ class CoordRPCHandler:
             "round": rid,
             "token": wire_token(trace.generate_token()),
         }
+        if model:
+            # off-default model rides only when requested: default
+            # rounds stay wire-identical to every earlier version
+            out["hash_model"] = model
+        return out
 
     def _found_params(self, trace, nonce: bytes, ntz: int, worker_byte: int,
-                      secret: bytes, rid: str) -> dict:
-        return {
+                      secret: bytes, rid: str,
+                      model: Optional[str] = None) -> dict:
+        out = {
             "nonce": bytes(nonce),
             "num_trailing_zeros": ntz,
             "worker_byte": worker_byte,
@@ -538,6 +565,9 @@ class CoordRPCHandler:
             "round": rid,
             "token": wire_token(trace.generate_token()),
         }
+        if model:
+            out["hash_model"] = model
+        return out
 
     def _mine_send_failure(self, w: WorkerRef, shard: int, rid: str,
                            exc: BaseException) -> None:
@@ -550,7 +580,8 @@ class CoordRPCHandler:
         self._mark_dead(w)
 
     def _send_mine(self, trace, nonce: bytes, ntz: int, w: WorkerRef,
-                   worker_byte: int, rid: str) -> bool:
+                   worker_byte: int, rid: str,
+                   model: Optional[str] = None) -> bool:
         """Issue one worker Mine and BLOCK for its ack (the reissue path
         and the serial baseline); under "reassign" a failure marks the
         worker dead and returns False instead of raising."""
@@ -561,7 +592,7 @@ class CoordRPCHandler:
         )
         fut = self._go_worker(
             w, "WorkerRPCHandler.Mine",
-            self._mine_params(trace, nonce, ntz, worker_byte, rid),
+            self._mine_params(trace, nonce, ntz, worker_byte, rid, model),
         )
         try:
             fut.result(timeout=self._call_timeout)
@@ -623,7 +654,8 @@ class CoordRPCHandler:
             orphans.append(shard)
         return tasks, orphans
 
-    def _assign_shards(self, trace, nonce: bytes, ntz: int, rid: str):
+    def _assign_shards(self, trace, nonce: bytes, ntz: int, rid: str,
+                       model: Optional[str] = None):
         """Fan the shard per worker (coordinator.go:179-199) — every
         Mine issued as a concurrent ``go()`` future before any reply is
         awaited; under "reassign", shards of dead workers go to live
@@ -637,12 +669,13 @@ class CoordRPCHandler:
             tasks: List[Tuple[WorkerRef, int]] = []
             orphans: List[int] = []
             for w in self.workers:
-                if self._send_mine(trace, nonce, ntz, w, w.worker_byte, rid):
+                if self._send_mine(trace, nonce, ntz, w, w.worker_byte,
+                                   rid, model):
                     tasks.append((w, w.worker_byte))
                 else:
                     orphans.append(w.worker_byte)
             tasks, pending = self._issue_shards(
-                trace, nonce, ntz, tasks, orphans, rid
+                trace, nonce, ntz, tasks, orphans, rid, model
             )
             if not tasks:
                 raise RuntimeError("no live workers to mine on")
@@ -657,7 +690,8 @@ class CoordRPCHandler:
             )
             futs.append((w, w.worker_byte, self._go_worker(
                 w, "WorkerRPCHandler.Mine",
-                self._mine_params(trace, nonce, ntz, w.worker_byte, rid),
+                self._mine_params(trace, nonce, ntz, w.worker_byte, rid,
+                                  model),
             )))
         if not reassign:
             # reference parity ("error"): every worker must take
@@ -691,13 +725,14 @@ class CoordRPCHandler:
                 tasks.append((w, shard))
                 inflight.append((w, shard, fut, deadline))
         tasks, pending = self._issue_shards(
-            trace, nonce, ntz, tasks, orphans, rid
+            trace, nonce, ntz, tasks, orphans, rid, model
         )
         if not tasks:
             raise RuntimeError("no live workers to mine on")
         return tasks, pending, inflight
 
-    def _mine_miss(self, trace, nonce: bytes, ntz: int) -> dict:
+    def _mine_miss(self, trace, nonce: bytes, ntz: int,
+                   model: Optional[str] = None) -> dict:
         self._initialize_workers()
         key = (nonce, ntz)
         # distpow: ok bounded-queue -- protocol-bounded: one round's
@@ -713,7 +748,7 @@ class CoordRPCHandler:
         probe_t = self.failure_probe_secs if reassign else None
         try:
             return self._mine_miss_locked(
-                trace, nonce, ntz, results, reassign, probe_t, rid
+                trace, nonce, ntz, results, reassign, probe_t, rid, model
             )
         finally:
             # every exit path (success, protocol violation, all-workers-
@@ -722,7 +757,8 @@ class CoordRPCHandler:
             self._task_delete(key)
 
     def _mine_miss_locked(self, trace, nonce: bytes, ntz: int, results,
-                          reassign: bool, probe_t, rid: str) -> dict:
+                          reassign: bool, probe_t, rid: str,
+                          model: Optional[str] = None) -> dict:
         metrics.inc("coord.fanouts")
         # the fan-out instant anchors this round's two latency
         # distributions: fanout->first-result (the race the paper's
@@ -730,7 +766,8 @@ class CoordRPCHandler:
         fanout_t0 = time.monotonic()
         RECORDER.record("coord.fanout", round=rid, nonce=nonce.hex(),
                         ntz=ntz)
-        tasks, pending, inflight = self._assign_shards(trace, nonce, ntz, rid)
+        tasks, pending, inflight = self._assign_shards(trace, nonce, ntz, rid,
+                                                       model)
 
         # first-result-wins (coordinator.go:202-206); under "reassign",
         # waiting is interleaved with liveness probes AND the harvest of
@@ -747,7 +784,8 @@ class CoordRPCHandler:
                 if not tasks:
                     raise RuntimeError("all workers died while mining")
                 tasks, pending = self._issue_shards(
-                    trace, nonce, ntz, tasks, pending + hung + orphans, rid
+                    trace, nonce, ntz, tasks, pending + hung + orphans, rid,
+                    model
                 )
         first_result_s = time.monotonic() - fanout_t0
         metrics.observe("coord.first_result_s", first_result_s)
@@ -762,7 +800,8 @@ class CoordRPCHandler:
             )
         winner = bytes(first["secret"])
 
-        tasks = self._broadcast_found(trace, nonce, ntz, winner, tasks, rid)
+        tasks = self._broadcast_found(trace, nonce, ntz, winner, tasks, rid,
+                                      model)
 
         # the 2-messages-per-task ack ledger (coordinator.go:237-248): the
         # finder already delivered 1 message; every surviving task owes 2
@@ -803,7 +842,7 @@ class CoordRPCHandler:
         # rebroadcast is acked once per task (cache-update-only round)
         for msg in late:
             tasks = self._broadcast_found(
-                trace, nonce, ntz, bytes(msg["secret"]), tasks, rid
+                trace, nonce, ntz, bytes(msg["secret"]), tasks, rid, model
             )
             owed = {shard: 1 for _, shard in tasks}
             while any(v > 0 for v in owed.values()):
@@ -830,7 +869,7 @@ class CoordRPCHandler:
                 # outcome
                 threading.Thread(
                     target=self._resync_abandoned,
-                    args=(trace, nonce, ntz, winner, abandoned, rid),
+                    args=(trace, nonce, ntz, winner, abandoned, rid, model),
                     daemon=True, name=f"resync-{rid[-8:]}",
                 ).start()
         return self._success_reply(trace, nonce, ntz, winner)
@@ -843,7 +882,7 @@ class CoordRPCHandler:
 
     def _resync_abandoned(self, trace, nonce: bytes, ntz: int,
                           secret: bytes, workers: List[WorkerRef],
-                          rid: str) -> None:
+                          rid: str, model: Optional[str] = None) -> None:
         """Best-effort Found to every worker not among the surviving
         tasks.  A worker falsely marked dead on a transient failure still
         has miner threads running (and a finder may be blocked waiting for
@@ -879,7 +918,7 @@ class CoordRPCHandler:
                     client.call(
                         "WorkerRPCHandler.Found",
                         self._found_params(trace, nonce, ntz, w.worker_byte,
-                                           secret, rid),
+                                           secret, rid, model),
                         timeout=max(0.1, deadline - time.monotonic()),
                     )
                 finally:
@@ -936,6 +975,7 @@ class CoordRPCHandler:
         secret: bytes,
         tasks: List[Tuple[WorkerRef, int]],
         rid: str,
+        model: Optional[str] = None,
     ) -> List[Tuple[WorkerRef, int]]:
         """Found-as-cancel+cache-install per task (coordinator.go:210-230);
         returns the tasks whose worker took delivery.  All Founds are
@@ -952,7 +992,8 @@ class CoordRPCHandler:
             )
             fut = self._go_worker(
                 w, "WorkerRPCHandler.Found",
-                self._found_params(trace, nonce, ntz, shard, secret, rid),
+                self._found_params(trace, nonce, ntz, shard, secret, rid,
+                                   model),
             )
             if self._serial_fanout:
                 # serial baseline: confirm before the next Found goes out
@@ -1074,6 +1115,14 @@ class Coordinator:
         )
         self.server = RPCServer()
         self.server.register("CoordRPCHandler", self.handler)
+        # role-agnostic Stats alias (distpow_tpu/obs/, docs/SLO.md):
+        # lets the fleet scraper's auto-role discovery resolve ANY
+        # current node without the unknown-service error a wrong-role
+        # probe earns — which would otherwise tick rpc.handler_errors
+        # on the very node being observed (the watcher-perturbation
+        # class the stats CLI's JSON pin already guards against).
+        # Stats-only view: the protocol surface stays single-named.
+        self.server.register("Node", StatsOnly(self.handler))
         self.client_addr: Optional[str] = None
         self.worker_addr: Optional[str] = None
 

@@ -169,7 +169,8 @@ class POW:
         self._close_ev.clear()
         return self.notify_queue
 
-    def mine(self, tracer: Tracer, nonce: bytes, num_trailing_zeros: int) -> None:
+    def mine(self, tracer: Tracer, nonce: bytes, num_trailing_zeros: int,
+             hash_model: Optional[str] = None) -> None:
         if self.coordinator is None:
             raise RuntimeError("powlib not initialized")
         nonce = bytes(nonce)
@@ -179,7 +180,8 @@ class POW:
         )
         t = threading.Thread(
             target=self._call_mine,
-            args=(tracer, nonce, num_trailing_zeros, trace),
+            args=(tracer, nonce, num_trailing_zeros, trace,
+                  hash_model or None),
             daemon=True,
         )
         with self._inflight_lock:
@@ -217,16 +219,19 @@ class POW:
             except CancelledError:
                 raise _Closed
 
-    def _issue_attempt(self, client, trace, nonce: bytes, ntz: int) -> dict:
-        """One Mine RPC attempt on ``client`` (fresh token per attempt)."""
-        fut = client.go(
-            "CoordRPCHandler.Mine",
-            {
-                "nonce": bytes(nonce),
-                "num_trailing_zeros": ntz,
-                "token": wire_token(trace.generate_token()),
-            },
-        )
+    def _issue_attempt(self, client, trace, nonce: bytes, ntz: int,
+                       hash_model: Optional[str] = None) -> dict:
+        """One Mine RPC attempt on ``client`` (fresh token per attempt).
+        ``hash_model`` rides as an extra param only when set, keeping
+        default-model frames wire-identical to every earlier version."""
+        params = {
+            "nonce": bytes(nonce),
+            "num_trailing_zeros": ntz,
+            "token": wire_token(trace.generate_token()),
+        }
+        if hash_model:
+            params["hash_model"] = hash_model
+        fut = client.go("CoordRPCHandler.Mine", params)
         return self._await_attempt(fut)
 
     def _reconnect(self, stale_gen: int, attempt: int) -> bool:
@@ -279,7 +284,8 @@ class POW:
             pass
         return True
 
-    def _mine_with_retry(self, trace, nonce: bytes, ntz: int) -> Optional[dict]:
+    def _mine_with_retry(self, trace, nonce: bytes, ntz: int,
+                         hash_model: Optional[str] = None) -> Optional[dict]:
         """Issue Mine until success, terminal failure (_MineFailed), or
         close (returns None).  See the module docstring for semantics.
 
@@ -296,6 +302,11 @@ class POW:
             if client is None:
                 return None
             try:
+                # default-model mines keep the historical 4-arg call
+                # shape (chaos tests stub _issue_attempt with it)
+                if hash_model:
+                    return self._issue_attempt(client, trace, nonce, ntz,
+                                               hash_model)
                 return self._issue_attempt(client, trace, nonce, ntz)
             except _Closed:
                 log.info("mine call abandoned on close")
@@ -351,14 +362,16 @@ class POW:
                 # would re-earn it — surface immediately (module docstring)
                 raise _MineFailed(str(exc))
 
-    def _call_mine(self, tracer, nonce, num_trailing_zeros, trace) -> None:
+    def _call_mine(self, tracer, nonce, num_trailing_zeros, trace,
+                   hash_model=None) -> None:
         t0 = time.monotonic()
         try:
             trace.record_action(
                 act.PowlibMine(nonce=nonce, num_trailing_zeros=num_trailing_zeros)
             )
             try:
-                result = self._mine_with_retry(trace, nonce, num_trailing_zeros)
+                result = self._mine_with_retry(trace, nonce,
+                                               num_trailing_zeros, hash_model)
             except _MineFailed as exc:
                 log.error("mine RPC failed: %s", exc)
                 if not self._close_ev.is_set():

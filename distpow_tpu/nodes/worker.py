@@ -46,7 +46,7 @@ from ..runtime import actions as act
 from ..runtime.cache import ResultCache
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import WorkerConfig
-from ..runtime.rpc import RPCClient, RPCServer
+from ..runtime.rpc import RPCClient, RPCServer, StatsOnly
 from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, make_tracer, wire_token
 from ..runtime.watchdog import WATCHDOG
@@ -444,8 +444,17 @@ class WorkerRPCHandler:
             return
         if secret is not None:
             # a REAL device solve (cache replays return above): this is
-            # the worker-side latency distribution of the paper's race
-            metrics.observe("worker.solve_s", time.monotonic() - t0)
+            # the worker-side latency distribution of the paper's race.
+            # The per-model family feeds the cluster aggregation's
+            # per-model breakdown and the per-model SLO objectives
+            # (distpow_tpu/obs/, docs/SLO.md) — per-hash performance
+            # spread is why serving targets cannot be global.
+            solve_s = time.monotonic() - t0
+            metrics.observe("worker.solve_s", solve_s)
+            metrics.observe(
+                f"worker.solve_s.{hash_model or self._default_model()}",
+                solve_s,
+            )
             self._finish_found(key, secret, round_, trace,
                                hash_model=hash_model if off_model else None)
             return
@@ -545,6 +554,9 @@ class Worker:
         )
         self.server = RPCServer()
         self.server.register("WorkerRPCHandler", self.handler)
+        # role-agnostic Stats alias for error-free auto-role discovery
+        # by the fleet scraper (runtime/rpc.py StatsOnly, docs/SLO.md)
+        self.server.register("Node", StatsOnly(self.handler))
         self.bound_addr: Optional[str] = None
         self._forwarder: Optional[threading.Thread] = None
         self._stopping = threading.Event()

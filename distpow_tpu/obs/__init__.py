@@ -1,0 +1,48 @@
+"""Cluster observability plane (ISSUE 8; docs/SLO.md).
+
+PR 3 gave every node its own registry, histograms, and ``stats --prom``
+— per-node observability.  This package is the *cluster-level* layer on
+top of it:
+
+* :mod:`.merge`  — bucket-wise merging of the per-node log-bucketed
+  histogram snapshots into cluster percentiles, plus counter/gauge
+  aggregation with per-node and per-hash-model breakdowns;
+* :mod:`.scrape` — a fleet scraper that polls every node's ``Stats``
+  RPC concurrently under one shared deadline (the PR 5 futures + wire
+  codec), marking unreachable or frozen nodes ``stale`` with their
+  last-seen age instead of stalling the sweep;
+* :mod:`.slo`    — a declarative SLO engine: objectives in a checked-in
+  config file (config/slo.json) evaluated over merged snapshots with
+  fast/slow burn-rate windows, producing a typed verdict, a nonzero
+  exit code for CI, and a flight-recorder breach event + critical-path
+  dump on breach.
+
+Consumers: ``python -m distpow_tpu.cli.stats --cluster``, ``python -m
+distpow_tpu.cli.slo``, the open-loop load harness
+(distpow_tpu/load/), ``bench.py --load-slo``, and
+``scripts/ci.sh --slo-smoke``.
+"""
+
+from .merge import merge_histograms, merge_snapshots, merged_percentile
+from .scrape import FleetScraper, NodeTarget, scrape_cluster
+from .slo import (
+    ObjectiveVerdict,
+    SLOConfigError,
+    SLOEngine,
+    SLOVerdict,
+    load_slo_config,
+)
+
+__all__ = [
+    "merge_histograms",
+    "merge_snapshots",
+    "merged_percentile",
+    "FleetScraper",
+    "NodeTarget",
+    "scrape_cluster",
+    "SLOConfigError",
+    "SLOEngine",
+    "SLOVerdict",
+    "ObjectiveVerdict",
+    "load_slo_config",
+]

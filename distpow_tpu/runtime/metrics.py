@@ -70,6 +70,12 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
   (runtime/faults.py; kind in refuse/delay/truncate/duplicate/drop)
 * ``telemetry.dropped_events`` / ``telemetry.dumps`` — flight-recorder
   ring overwrites and dump-on-fault snapshots (runtime/telemetry.py)
+* ``obs.scrapes`` / ``obs.scrape_failures`` — fleet-scraper sweeps
+  issued and per-node polls that failed or missed the shared sweep
+  deadline (distpow_tpu/obs/scrape.py, docs/SLO.md)
+* ``slo.evaluations`` / ``slo.breaches`` — SLO-engine verdict runs and
+  verdicts that breached (distpow_tpu/obs/slo.py; every breach also
+  records an ``slo.breach`` flight-recorder event)
 
 Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 ``KNOWN_HISTOGRAM_PREFIXES`` vs ``observe()``/``time()`` call sites):
@@ -79,6 +85,11 @@ Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 * ``coord.first_result_s``       — fan-out to first worker result
 * ``coord.cancel_propagation_s`` — fan-out to last cancellation ACK
 * ``worker.solve_s``          — backend search latency for found secrets
+* ``worker.solve_s.<model>``  — the same distribution split per hash
+  model (family; the per-model SLO objectives and the cluster
+  aggregation's per-model breakdown read these — docs/SLO.md)
+* ``obs.sweep_s``      — fleet-scraper merge time per sweep
+  (distpow_tpu/obs/scrape.py)
 * ``worker.time_to_cancel_s`` — Mine receipt to honored cancellation
 * ``search.launch_s``  — time blocked fetching one launch's result
   (the serial driver's FIFO drain; parallel/search.py)
@@ -136,6 +147,8 @@ KNOWN_COUNTERS = frozenset({
     "compile_cache.errors", "compile_cache.read_errors",
     "compile_cache.write_errors", "compile_cache.keygen_errors",
     "telemetry.dropped_events", "telemetry.dumps",
+    "obs.scrapes", "obs.scrape_failures",
+    "slo.evaluations", "slo.breaches",
 })
 
 # Families minted from runtime values (f-string call sites): the
@@ -155,6 +168,7 @@ KNOWN_HISTOGRAMS = frozenset({
     "powlib.mine_s",
     "sched.batch_occupancy", "sched.slot_wait_s",
     "rpc.frame.sent_bytes", "rpc.frame.recv_bytes",
+    "obs.sweep_s",
 })
 
 # Per-method families (runtime/rpc.py mints one histogram per
@@ -162,6 +176,7 @@ KNOWN_HISTOGRAMS = frozenset({
 KNOWN_HISTOGRAM_PREFIXES = frozenset({
     "rpc.client.call_s.",
     "rpc.server.dispatch_s.",
+    "worker.solve_s.",  # per-hash-model solve latency (nodes/worker.py)
 })
 
 # Log-bucket geometry: 4 buckets per octave (bounds grow by 2^0.25, so a

@@ -222,6 +222,21 @@ def split_bind_addr(addr: str) -> Tuple[str, int]:
     return host, int(port)
 
 
+class StatsOnly:
+    """Stats-only view of a node handler, for registration under the
+    role-agnostic ``Node`` service name (nodes/coordinator.py,
+    nodes/worker.py): observability callers resolve any node's Stats
+    without knowing — or mis-probing — its role, so auto-role discovery
+    never mints ``rpc.handler_errors`` on the node being observed
+    (distpow_tpu/obs/scrape.py, docs/SLO.md)."""
+
+    def __init__(self, handler):
+        self._handler = handler
+
+    def Stats(self, params) -> dict:
+        return self._handler.Stats(params)
+
+
 class RPCServer:
     """Multi-listener RPC server dispatching ``Service.Method`` requests.
 

@@ -30,7 +30,12 @@
 # match the serial driver's first hit with zero blocking host syncs,
 # and a mixed-hash (md5+sha1) batch through an in-process worker must
 # spend fewer launches than the per-model solo baseline — ~30 s, CPU.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke]
+# `--slo-smoke` runs the deterministic SLO-gate smoke
+# (scripts/slo_smoke.py, docs/SLO.md): an open-loop Poisson burst on an
+# in-process cluster must pass the checked-in config/slo.json (exit 0)
+# while a tightened copy must breach (nonzero exit + slo.breach
+# flight-recorder event + ring dump) — ~15 s, CPU.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -79,6 +84,13 @@ if [ "${1:-}" = "--serving-smoke" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "--slo-smoke" ]; then
+  echo "=== SLO gate smoke (open-loop load + cluster merge + breach evidence) ==="
+  JAX_PLATFORMS=cpu python scripts/slo_smoke.py
+  echo "=== slo smoke OK ==="
+  exit 0
+fi
+
 if [ "${1:-}" = "--bench-rehearsal" ]; then
   echo "=== bench rehearsal (CPU platform, temp provenance) ==="
   tmp="$(mktemp -d)"
@@ -117,7 +129,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke]" >&2
           exit 2 ;;
 esac
 

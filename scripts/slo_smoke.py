@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Deterministic SLO-gate smoke (scripts/ci.sh --slo-smoke; docs/SLO.md).
+
+Proves the observe-assert-generate triad end to end on CPU, in-process:
+
+1. boot a real cluster (coordinator + 2 python-backend workers), replay
+   a seeded open-loop Poisson burst with Zipf key skew through the load
+   harness while the fleet scraper sweeps the nodes' Stats RPCs;
+2. the checked-in GREEN config (config/slo.json) must evaluate to a
+   passing verdict — exit code 0;
+3. a TIGHTENED copy (mine p95 budget squeezed below anything physical)
+   must evaluate to a BREACH — nonzero exit code, an ``slo.breach``
+   flight-recorder event, and a ring dump (with the verdict riding in
+   it) in the temp telemetry dir.
+
+Prints one JSON summary line on stdout (details to stderr); exits 0
+only when BOTH halves of the contract held — the shape
+scripts/chaos_smoke.py established for CI lanes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distpow_tpu.load import LoadMix, run_load_slo  # noqa: E402
+from distpow_tpu.obs import load_slo_config  # noqa: E402
+from distpow_tpu.runtime.telemetry import RECORDER  # noqa: E402
+
+RATE_HZ = float(os.environ.get("SLO_SMOKE_RATE_HZ", "8"))
+DURATION_S = float(os.environ.get("SLO_SMOKE_DURATION_S", "4"))
+GREEN_CONFIG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "config", "slo.json")
+
+
+def tightened(green: str) -> dict:
+    """The green config with the mine-p95 budget squeezed to 1 µs —
+    no cluster on any hardware can pass it, which is the point: the
+    smoke proves the gate FAILS when the objective says it must."""
+    with open(green) as fh:
+        cfg = json.load(fh)
+    for o in cfg["objectives"]:
+        if o["name"] == "mine_e2e_p95_s":
+            o["max"] = 1e-6
+    return cfg
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as td:
+        # dump-on-breach needs a dump dir; the ring keeps whatever
+        # directory it got first, so configure before any traffic
+        RECORDER.configure(dump_dir=td)
+        mix = LoadMix(rate_hz=RATE_HZ, duration_s=DURATION_S, seed=905,
+                      n_keys=12, zipf_s=1.1,
+                      difficulties=((1, 0.7), (2, 0.3)))
+        green_report, green_verdict = run_load_slo(
+            mix, GREEN_CONFIG, n_workers=2, scrape_interval_s=0.5,
+        )
+        print(f"[slo-smoke] green: verdict={green_verdict.status} "
+              f"exit={green_verdict.exit_code()} "
+              f"{green_report['achieved_solves_per_s']} solves/s, "
+              f"{green_report['merged']['cache_hits']} cache hits",
+              file=sys.stderr)
+
+        tight_mix = LoadMix(rate_hz=RATE_HZ, duration_s=DURATION_S,
+                            seed=906, n_keys=12, zipf_s=1.1,
+                            difficulties=((1, 0.7), (2, 0.3)))
+        tight_report, tight_verdict = run_load_slo(
+            tight_mix, load_slo_config(tightened(GREEN_CONFIG)),
+            n_workers=2, scrape_interval_s=0.5,
+        )
+        breach_events = [e for e in RECORDER.recent()
+                         if e["kind"] == "slo.breach"]
+        dumps = [f for f in os.listdir(td) if f.startswith("flightrec-")]
+        print(f"[slo-smoke] tightened: verdict={tight_verdict.status} "
+              f"exit={tight_verdict.exit_code()}, "
+              f"{len(breach_events)} breach event(s), "
+              f"{len(dumps)} dump(s)", file=sys.stderr)
+
+        summary = {
+            "green_status": green_verdict.status,
+            "green_exit": green_verdict.exit_code(),
+            "green_solves_per_s": green_report["achieved_solves_per_s"],
+            "green_requests": green_report["completed"],
+            "tightened_status": tight_verdict.status,
+            "tightened_exit": tight_verdict.exit_code(),
+            "breach_events": len(breach_events),
+            "breach_dumps": len(dumps),
+            "stale_nodes": green_report["merged"]["stale_nodes"],
+        }
+        print(json.dumps(summary))
+        if green_verdict.exit_code() != 0 or green_report["request_errors"]:
+            print("[slo-smoke] FAIL: green config did not pass",
+                  file=sys.stderr)
+            return 1
+        if tight_verdict.exit_code() == 0:
+            print("[slo-smoke] FAIL: tightened config did not breach",
+                  file=sys.stderr)
+            return 1
+        if not breach_events or not dumps:
+            print("[slo-smoke] FAIL: breach left no flight-recorder "
+                  "evidence", file=sys.stderr)
+            return 1
+        print("[slo-smoke] OK: green passes, tightened breaches with "
+              "recorded evidence", file=sys.stderr)
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

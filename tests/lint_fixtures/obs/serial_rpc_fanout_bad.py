@@ -1,0 +1,20 @@
+"""Fixture: serial-rpc-fanout must fire in obs/ too — a sequential
+Stats scrape loop is the nodes/ fan-out bug one layer up (3 findings)."""
+
+
+def sweep_serial(self, targets):
+    snaps = {}
+    for t in targets:
+        snaps[t.name] = t.client.call("CoordRPCHandler.Stats", {})  # 1
+    return snaps
+
+
+def poll_states(states):
+    for st in {id(s): s for s in states}.values():
+        st.client.call("WorkerRPCHandler.Stats", {}, timeout=2.0)  # 2
+
+
+def nested_node_groups(node_groups):
+    for group in node_groups:
+        for n in group:
+            n.call("X.Stats", {})  # 3 (nested loop, same scope)

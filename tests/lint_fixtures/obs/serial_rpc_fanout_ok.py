@@ -1,0 +1,37 @@
+"""Fixture: the sanctioned scrape shapes must not trip
+serial-rpc-fanout in obs/."""
+
+import subprocess
+import threading
+
+
+def concurrent_sweep(targets, deadline):
+    # the sanctioned shape: one poll thread per node, all bounded by
+    # one shared deadline — the obs/scrape.py structure
+    threads = []
+    for t in targets:
+        def poll(t=t):
+            # nested function body: executes on its own thread, outside
+            # the loop's dynamic extent
+            return t.client.call("CoordRPCHandler.Stats", {},
+                                 timeout=deadline)
+        th = threading.Thread(target=poll, daemon=True)
+        th.start()
+        threads.append(th)
+    return threads
+
+
+def futures_then_await(targets):
+    futs = [t.client.go("WorkerRPCHandler.Stats", {}) for t in targets]
+    for fut in futs:
+        fut.result(timeout=5.0)
+
+
+def not_a_peer_collection(rows):
+    for row in rows:
+        row.client.call("CoordRPCHandler.Stats", row)
+
+
+def subprocess_is_not_rpc(node_cmds):
+    for cmd in node_cmds:
+        subprocess.call(cmd)

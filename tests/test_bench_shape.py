@@ -364,3 +364,87 @@ def test_finalize_re_suspect_remeasure_keeps_flag(bench):
     assert prov["suspect_readings"]["sha3_256-serving"]["measured_mhs"] \
         == 0.9
     assert prov["suspect_rows"] == ["sha3_256-serving"]
+
+
+# -- empty-md5 pool guard (advisor r5 low #3; regression test ISSUE 8) -------
+
+def test_finalize_empty_rates_returns_device_hung_line(bench):
+    """finalize_record with NO md5 label must return the device-hung
+    shape instead of raising ValueError on max() over an empty pool —
+    main()'s final call must not rely on an earlier stage crashing
+    first.  Provenance stays None: a run that measured no md5 stage
+    must not re-stamp last_measured.json."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None)
+    assert prov is None
+    assert "device hung" in line["metric"]
+    assert line["value"] == 0.0 and line["unit"] == "MH/s"
+
+
+def test_finalize_non_md5_rates_returns_device_hung_line_with_rates(bench):
+    """Diagnostic-only measurements (the device died before any md5
+    stage) still ride the hung line's rates_mhs — measured evidence is
+    never dropped — but the headline stays the hung shape."""
+    line, prov = bench.finalize_record(
+        {"sha3_256-serving": 6.1e6}, LAST_FULL, None,
+        note="died before phase A",
+    )
+    assert prov is None
+    assert "device hung" in line["metric"]
+    assert line["rates_mhs"] == {"sha3_256-serving": 6.1}
+    assert line["note"] == "died before phase A"
+
+
+# -- load-slo row (ISSUE 8) --------------------------------------------------
+
+LS = {
+    "slo_config": "config/slo.json", "duration_s": 5.0, "ok": True,
+    "rates": {
+        "r6": {"target_hz": 6.0, "achieved_solves_per_s": 6.4,
+               "merged_miss_p95_ms": 119.2, "verdict": "pass",
+               "oracle_within_bucket": True},
+        "r12": {"target_hz": 12.0, "achieved_solves_per_s": 11.5,
+                "merged_miss_p95_ms": 433.6, "verdict": "pass",
+                "oracle_within_bucket": True},
+    },
+}
+
+
+def test_finalize_attaches_load_slo_row(bench):
+    """The load-slo stage rides both artifacts of a normal run, like
+    the control-plane and serving-loop rows."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_FULL, 5.35e6, load_slo=LS
+    )
+    assert line["load_slo"] == LS
+    assert prov["load_slo"] == LS
+    assert line["unit"] == "MH/s"
+
+
+def test_finalize_load_slo_only_run(bench):
+    """bench.py --load-slo: the headline becomes the highest offered
+    rate's achieved solves/s and kernel provenance is NOT re-stamped."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None, load_slo=LS)
+    assert prov is None
+    assert line["unit"] == "solves/s"
+    assert line["value"] == 11.5  # the r12 row, selected by target_hz
+    assert "12" in line["metric"]
+    assert line["load_slo"] == LS
+
+
+def test_finalize_carries_forward_load_slo(bench):
+    lm = dict(LAST_FULL, load_slo=LS)
+    line, prov = bench.finalize_record({"serving": 9800.0e6}, lm, 5.35e6)
+    assert prov["load_slo"] == LS
+    assert "load_slo" not in line
+
+
+def test_finalize_control_plane_headline_attaches_load_slo(bench):
+    """On a device-unreachable run that measured both CPU stages the
+    control-plane row stays the headline and the load-slo dict rides
+    along."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, control_plane=CP, load_slo=LS
+    )
+    assert prov is None
+    assert line["unit"] == "ms"
+    assert line["load_slo"] == LS

@@ -65,6 +65,48 @@ def test_keygen_log_error_is_counted():
     assert compile_cache.error_count() == 1
 
 
+def test_classify_anchors_on_literal_jax_phrasings():
+    """Regression for the advisor-r5 substring heuristic (ISSUE 8
+    satellite): the old ``"read" in m.split("cache")[0]`` matched the
+    'read' inside words like 'thread', misclassifying unrelated cache
+    warnings as read errors.  _classify must anchor on jax's LITERAL
+    'error reading'/'error writing' phrasings and let any other
+    cache-related message degrade to the total counter only."""
+    # 'thread' before 'compilation cache', no literal 'error reading':
+    # cache-related, so counted — but ONLY in the total
+    assert compile_cache._classify(
+        "a worker thread hit a persistent compilation cache problem"
+    ) == compile_cache.ERRORS_TOTAL
+    # 'spread'/'already' style words must not trip 'read' either
+    assert compile_cache._classify(
+        "cache key spread warning touching the compilation cache"
+    ) == compile_cache.ERRORS_KEYGEN  # 'cache key' IS a literal anchor
+    assert compile_cache._classify(
+        "compilation cache entry already present, skipping"
+    ) == compile_cache.ERRORS_TOTAL
+    # the literal phrasings still classify into their breakdowns
+    assert compile_cache._classify(
+        "Error reading persistent compilation cache entry for 'jit_x'"
+    ) == compile_cache.ERRORS_READ
+    assert compile_cache._classify(
+        "Error writing persistent compilation cache entry for 'jit_x'"
+    ) == compile_cache.ERRORS_WRITE
+    # non-cache messages stay out entirely
+    assert compile_cache._classify("error reading some config file") is None
+
+
+def test_classify_total_only_message_counts_once():
+    """A cache message with no breakdown anchor increments the total
+    counter exactly once and no breakdown counter at all."""
+    assert compile_cache._count(
+        "persistent compilation cache hiccup in a worker thread", "warning"
+    )
+    assert compile_cache.error_count() == 1
+    assert REGISTRY.get(compile_cache.ERRORS_READ) == 0
+    assert REGISTRY.get(compile_cache.ERRORS_WRITE) == 0
+    assert REGISTRY.get(compile_cache.ERRORS_KEYGEN) == 0
+
+
 def test_unrelated_messages_not_counted():
     assert not compile_cache._count("Some unrelated deprecation", "warning")
     logging.getLogger("jax._src.compiler").error("unrelated error")

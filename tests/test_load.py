@@ -1,0 +1,242 @@
+"""Open-loop load harness (distpow_tpu/load/, ISSUE 8): seeded
+schedule determinism, Zipf skew, genuine open-loop dispatch, the
+end-to-end harness against a real in-process cluster (cache/coalesce
+evidence, SLO green-vs-tightened), chaos-under-load, and the
+coordinator's hash-model pass-through."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from distpow_tpu.load import (  # noqa: E402
+    InProcCluster,
+    LoadMix,
+    OpenLoopRunner,
+    build_schedule,
+    exact_percentile,
+    run_load_slo,
+)
+from distpow_tpu.load.loadgen import key_nonce  # noqa: E402
+from distpow_tpu.obs import load_slo_config  # noqa: E402
+from distpow_tpu.models import puzzle  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SLO_GREEN = os.path.join(REPO, "config", "slo.json")
+
+
+# -- seeded schedule determinism ---------------------------------------------
+
+def test_schedule_is_deterministic_per_seed():
+    mix = LoadMix(rate_hz=50.0, duration_s=2.0, seed=905, n_keys=32,
+                  zipf_s=1.1, difficulties=((1, 0.5), (2, 0.5)))
+    assert build_schedule(mix) == build_schedule(mix)
+    other = build_schedule(LoadMix(rate_hz=50.0, duration_s=2.0, seed=906,
+                                   n_keys=32, zipf_s=1.1,
+                                   difficulties=((1, 0.5), (2, 0.5))))
+    assert build_schedule(mix) != other
+
+
+def test_schedule_arrivals_are_poisson_shaped():
+    mix = LoadMix(rate_hz=100.0, duration_s=10.0, seed=1)
+    sched = build_schedule(mix)
+    # ~rate*duration arrivals, monotonic offsets inside the window
+    assert 800 <= len(sched) <= 1200
+    ts = [a.t for a in sched]
+    assert ts == sorted(ts)
+    assert 0.0 < ts[0] and ts[-1] < 10.0
+
+
+def test_zipf_skew_concentrates_keys():
+    flat = build_schedule(LoadMix(rate_hz=200.0, duration_s=5.0, seed=3,
+                                  n_keys=64, zipf_s=0.0))
+    skew = build_schedule(LoadMix(rate_hz=200.0, duration_s=5.0, seed=3,
+                                  n_keys=64, zipf_s=1.3))
+
+    def hot_share(sched):
+        counts = {}
+        for a in sched:
+            counts[a.key] = counts.get(a.key, 0) + 1
+        return max(counts.values()) / len(sched)
+
+    assert hot_share(skew) > 3 * hot_share(flat)
+    # repeats of one key genuinely repeat the nonce (the cache point)
+    by_key = {}
+    for a in skew:
+        by_key.setdefault(a.key, set()).add(a.nonce)
+    assert all(len(nonces) == 1 for nonces in by_key.values())
+
+
+def test_nonces_disjoint_across_seeds():
+    """Two mixes must not cross-hit each other's dominance-cache
+    entries — bench.py --load-slo runs one seed per rate."""
+    a = {key_nonce(41, k, 4) for k in range(64)}
+    b = {key_nonce(42, k, 4) for k in range(64)}
+    assert not (a & b)
+
+
+def test_difficulty_and_model_blends_sampled():
+    mix = LoadMix(rate_hz=200.0, duration_s=3.0, seed=5,
+                  difficulties=((1, 0.5), (3, 0.5)),
+                  hash_models=((None, 0.7), ("sha1", 0.3)))
+    sched = build_schedule(mix)
+    ntzs = {a.ntz for a in sched}
+    models = {a.hash_model for a in sched}
+    assert ntzs == {1, 3}
+    assert models == {None, "sha1"}
+    share = sum(1 for a in sched if a.hash_model == "sha1") / len(sched)
+    assert 0.15 < share < 0.45
+
+
+def test_mix_validation():
+    with pytest.raises(ValueError):
+        LoadMix(rate_hz=0.0, duration_s=1.0)
+    with pytest.raises(ValueError):
+        LoadMix(rate_hz=1.0, duration_s=1.0, difficulties=())
+    with pytest.raises(ValueError):
+        LoadMix(rate_hz=1.0, duration_s=1.0, n_keys=0)
+
+
+# -- the open-loop runner ----------------------------------------------------
+
+def test_runner_is_open_loop_under_slow_completions():
+    """Arrivals fire on schedule even though nothing ever completes —
+    the defining property: a slow server faces the offered rate."""
+    fired = []
+    runner = OpenLoopRunner(lambda a: fired.append(
+        (time.monotonic(), a.t)))
+    mix = LoadMix(rate_hz=40.0, duration_s=1.0, seed=9)
+    rep = runner.run(build_schedule(mix))
+    assert rep.issued == len(fired) > 20
+    assert rep.submit_errors == 0
+    # dispatch stayed on schedule (no completion ever unblocked it)
+    assert rep.max_lag_s < 0.5
+    t0 = fired[0][0] - fired[0][1]
+    for fire_t, sched_t in fired:
+        assert fire_t - t0 >= sched_t - 0.05
+
+
+def test_runner_counts_submit_errors_and_continues():
+    calls = []
+
+    def submit(a):
+        calls.append(a)
+        if len(calls) % 2 == 0:
+            raise RuntimeError("boom")
+
+    rep = OpenLoopRunner(submit).run(
+        build_schedule(LoadMix(rate_hz=50.0, duration_s=0.5, seed=2)))
+    assert rep.issued == len(calls)
+    assert rep.submit_errors == len(calls) // 2
+
+
+def test_runner_stop_aborts_schedule():
+    runner = OpenLoopRunner(lambda a: None)
+    sched = build_schedule(LoadMix(rate_hz=5.0, duration_s=30.0, seed=4))
+    import threading
+
+    threading.Timer(0.3, runner.stop).start()
+    t0 = time.monotonic()
+    rep = runner.run(sched)
+    assert time.monotonic() - t0 < 5.0
+    assert rep.issued < len(sched)
+
+
+def test_exact_percentile():
+    assert exact_percentile([], 0.95) is None
+    assert exact_percentile([3.0, 1.0, 2.0], 0.5) == 2.0
+    assert exact_percentile([1.0], 0.99) == 1.0
+
+
+# -- end-to-end harness ------------------------------------------------------
+
+def test_harness_green_run_with_cache_and_coalesce_evidence():
+    """A skewed open-loop burst against a real cluster: everything
+    completes, repeats hit the dominance cache, and the checked-in
+    green SLO config passes over the merged run window."""
+    mix = LoadMix(rate_hz=12.0, duration_s=2.5, seed=905, n_keys=8,
+                  zipf_s=1.2, difficulties=((1, 0.7), (2, 0.3)))
+    report, verdict = run_load_slo(mix, SLO_GREEN, n_workers=2,
+                                   scrape_interval_s=0.5)
+    assert report["request_errors"] == 0
+    assert report["completed"] == report["load"]["issued"] > 10
+    assert report["merged"]["cache_hits"] > 0  # the Zipf point
+    assert report["merged"]["stale_nodes"] == []
+    assert report["achieved_solves_per_s"] > mix.rate_hz / 3
+    assert verdict.status in ("pass", "warn")
+    assert verdict.exit_code() == 0
+
+
+def test_harness_tightened_config_breaches():
+    tight = load_slo_config({
+        "objectives": [{"name": "mine_e2e_p95_s",
+                        "histogram": "coord.mine_s.miss",
+                        "stat": "p95", "max": 1e-6}]})
+    mix = LoadMix(rate_hz=10.0, duration_s=1.5, seed=907, n_keys=6,
+                  difficulties=((1, 1.0),))
+    report, verdict = run_load_slo(mix, tight, n_workers=1,
+                                   breach_hooks=False)
+    assert report["completed"] > 0
+    assert verdict.status == "breach"
+    assert verdict.exit_code() == 1
+
+
+@pytest.mark.faults
+def test_harness_chaos_under_load_still_completes():
+    """PR 1 fault plane under open-loop traffic: seeded server-side
+    delays on the worker Mine path slow rounds down but every request
+    still completes and the harness reports it faithfully."""
+    mix = LoadMix(rate_hz=6.0, duration_s=2.0, seed=911, n_keys=6,
+                  difficulties=((1, 1.0),))
+    report, verdict = run_load_slo(
+        mix, SLO_GREEN, n_workers=2, scrape_interval_s=0.5,
+        fault_spec={"seed": 905, "rules": [
+            {"kind": "delay", "side": "server",
+             "method": "WorkerRPCHandler.Mine", "delay_s": 0.05},
+        ]},
+    )
+    assert report["mix"]["chaos"] is True
+    assert report["request_errors"] == 0
+    assert report["completed"] == report["load"]["issued"]
+    assert verdict.exit_code() == 0
+
+
+@pytest.mark.slow
+def test_coordinator_hash_model_pass_through_end_to_end():
+    """The coordinator seam (ISSUE 8): a client Mine carrying
+    ``hash_model`` routes through the coordinator to a model-capable
+    scheduler worker, solves under THAT hash, skips the single-model
+    dominance cache, and lands in the per-model solve histogram."""
+    from distpow_tpu.runtime.metrics import REGISTRY
+
+    cluster = InProcCluster(
+        n_workers=1, backend="jax",
+        worker_extra={"Scheduler": "batching", "SchedMaxSlots": 4,
+                      "SchedHashModels": ["sha1"], "BatchSize": 1 << 10},
+    )
+    try:
+        h0 = REGISTRY.get_histogram("worker.solve_s.sha1") or {"count": 0}
+        cluster.client.mine(b"\xa7\x01", 2, hash_model="sha1")
+        res = cluster.client.notify_queue.get(timeout=120)
+        assert res.error is None, res.error
+        assert puzzle.check_secret(res.nonce, res.secret, 2, "sha1")
+        # the sha1 secret must NOT be servable from the coordinator's
+        # (md5) dominance cache
+        coord = cluster.coordinator.handler
+        assert coord.result_cache.satisfies(b"\xa7\x01", 2) is None
+        # a default-model mine for the same nonce leads its own round
+        # and returns an md5-valid secret
+        cluster.client.mine(b"\xa7\x01", 2)
+        res2 = cluster.client.notify_queue.get(timeout=120)
+        assert res2.error is None, res2.error
+        assert puzzle.check_secret(res2.nonce, res2.secret, 2)
+        # per-model breakdown observed the off-default solve
+        h1 = REGISTRY.get_histogram("worker.solve_s.sha1")
+        assert h1 and h1["count"] > h0["count"]
+    finally:
+        cluster.close()

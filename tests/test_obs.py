@@ -1,0 +1,584 @@
+"""Cluster observability plane (distpow_tpu/obs/, ISSUE 8): histogram
+merging vs a combined-stream oracle, the shared-deadline fleet scraper
+(including a real SIGSTOP'd worker process), and the SLO engine's
+verdict edges, burn-rate windows, unknown-metric rejection, and breach
+evidence."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from distpow_tpu.obs import (  # noqa: E402
+    FleetScraper,
+    NodeTarget,
+    SLOConfigError,
+    SLOEngine,
+    load_slo_config,
+    merge_histograms,
+    merge_snapshots,
+)
+from distpow_tpu.obs.merge import BUCKET_RATIO, delta_histogram  # noqa: E402
+from distpow_tpu.runtime.metrics import Histogram, Metrics  # noqa: E402
+from distpow_tpu.runtime.rpc import RPCServer  # noqa: E402
+from distpow_tpu.runtime.telemetry import RECORDER  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def hist_dict(samples):
+    h = Histogram()
+    for v in samples:
+        h.observe(v)
+    return h.to_dict()
+
+
+# -- bucket-wise merging vs the combined-stream oracle -----------------------
+
+def test_merge_matches_combined_stream_exactly():
+    """Bucketing is deterministic per value, so merging N node
+    histograms bucket-wise must EQUAL the histogram one node observing
+    the union stream would have built — not just approximate it."""
+    rng = random.Random(905)
+    a = [rng.lognormvariate(-3.0, 1.5) for _ in range(400)]
+    b = [rng.lognormvariate(-1.0, 1.0) for _ in range(300)]
+    c = [rng.uniform(0.0, 2.0) for _ in range(100)]  # includes zeros path
+    merged = merge_histograms([hist_dict(a), hist_dict(b), hist_dict(c)])
+    oracle = hist_dict(a + b + c)
+    assert merged == oracle
+
+
+def test_merge_percentile_within_one_bucket_of_true_value():
+    """The merged estimate inherits the single-node error bound: each
+    reported percentile sits within one log bucket (~19%) of the true
+    sample percentile."""
+    rng = random.Random(17)
+    a = [rng.lognormvariate(-4.0, 1.0) for _ in range(500)]
+    b = [rng.lognormvariate(-2.0, 0.5) for _ in range(500)]
+    merged = merge_histograms([hist_dict(a), hist_dict(b)])
+    both = sorted(a + b)
+    for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+        true = both[min(len(both) - 1, int(q * len(both)))]
+        est = merged[key]
+        assert est / true <= BUCKET_RATIO + 1e-9, (key, est, true)
+        assert true / est <= BUCKET_RATIO + 1e-9, (key, est, true)
+
+
+def test_merge_single_snapshot_is_identity():
+    h = hist_dict([0.01, 0.5, 2.0, 0.0])
+    assert merge_histograms([h]) == h
+
+
+def test_merge_handles_empty_and_none():
+    h = hist_dict([1.0])
+    assert merge_histograms([h, {}, None]) == h
+    empty = merge_histograms([])
+    assert empty["count"] == 0 and empty["p95"] is None
+
+
+def test_delta_histogram_is_the_between_window():
+    first = [0.01, 0.02, 0.4]
+    later = [0.8, 0.9, 1.7, 3.2]
+    old = hist_dict(first)
+    new = hist_dict(first + later)
+    delta = delta_histogram(new, old)
+    assert delta["count"] == len(later)
+    assert abs(delta["sum"] - sum(later)) < 1e-6
+    # the window's percentile reflects only the later samples
+    assert delta["p50"] >= 0.8 / BUCKET_RATIO
+
+
+def test_delta_histogram_clamps_counter_resets():
+    """A restarted node's snapshot shrinks; the delta must clamp at
+    zero instead of poisoning the percentile walk with negatives."""
+    old = hist_dict([0.1] * 10)
+    new = hist_dict([0.2])  # fresh registry after restart
+    delta = delta_histogram(new, old)
+    assert delta["count"] == 0
+    assert all(c >= 0 for _, c in delta["buckets"])
+
+
+def test_merge_snapshots_sums_and_breaks_down():
+    m1, m2 = Metrics(), Metrics()
+    m1.inc("coord.mine_rpcs", 5)
+    m2.inc("coord.mine_rpcs", 7)
+    m1.observe("worker.solve_s.md5", 0.01)
+    m2.observe("worker.solve_s.sha1", 0.5)
+    s1, s2 = m1.snapshot(), m2.snapshot()
+    s1["role"], s2["role"] = "coordinator", "worker"
+    merged = merge_snapshots({"c": s1, "w": s2})
+    assert merged["counters"]["coord.mine_rpcs"] == 12
+    assert set(merged["per_model"]) == {"md5", "sha1"}
+    assert merged["per_node"]["c"]["role"] == "coordinator"
+    assert merged["per_node"]["w"]["counters"]["coord.mine_rpcs"] == 7
+    assert merged["stale_nodes"] == []
+
+
+# -- the fleet scraper -------------------------------------------------------
+
+class _StatsNode:
+    """A real RPCServer whose Stats serves a private Metrics registry —
+    genuinely distinct per-node registries, unlike in-process nodes."""
+
+    def __init__(self, role="worker", freeze=None):
+        self.metrics = Metrics()
+        self.role = role
+        self.freeze = freeze  # threading.Event-like; when set, hang
+        node = self
+
+        class Handler:
+            def Stats(self, params):
+                if node.freeze is not None and node.freeze.is_set():
+                    time.sleep(60)
+                snap = node.metrics.snapshot()
+                snap["role"] = node.role
+                return snap
+
+        self.server = RPCServer()
+        service = ("CoordRPCHandler" if role == "coordinator"
+                   else "WorkerRPCHandler")
+        self.server.register(service, Handler())
+        self.addr = self.server.listen("127.0.0.1:0")
+        self.server.serve_in_background()
+
+    def close(self):
+        self.server.shutdown()
+
+
+@pytest.fixture
+def three_nodes():
+    import threading
+
+    freeze = threading.Event()
+    coord = _StatsNode("coordinator")
+    w1 = _StatsNode("worker")
+    w2 = _StatsNode("worker", freeze=freeze)
+    yield coord, w1, w2, freeze
+    for n in (coord, w1, w2):
+        n.close()
+
+
+def test_scraper_merges_distinct_registries(three_nodes):
+    coord, w1, w2, _ = three_nodes
+    coord.metrics.inc("coord.mine_rpcs", 3)
+    w1.metrics.observe("worker.solve_s.md5", 0.1)
+    w2.metrics.observe("worker.solve_s.md5", 0.4)
+    scraper = FleetScraper([
+        NodeTarget(coord.addr, "coord", "coordinator"),
+        NodeTarget(w1.addr, "w1", "worker"),
+        NodeTarget(w2.addr, "w2", "worker"),
+    ], deadline_s=5.0)
+    try:
+        snap = scraper.sweep()
+    finally:
+        scraper.close()
+    assert snap["stale_nodes"] == []
+    assert snap["counters"]["coord.mine_rpcs"] == 3
+    md5 = snap["per_model"]["md5"]["solve_s"]
+    assert md5["count"] == 2  # one sample from each worker registry
+    oracle = merge_histograms([hist_dict([0.1]), hist_dict([0.4])])
+    assert snap["histograms"]["worker.solve_s.md5"] == oracle
+
+
+def test_scraper_marks_frozen_node_stale_within_deadline(three_nodes):
+    """The SIGSTOP-shaped contract at the RPC level: a node whose Stats
+    never answers costs the sweep its shared deadline, not a hang — it
+    is reported stale with its last-seen age and its LAST snapshot
+    keeps contributing, flagged."""
+    coord, w1, w2, freeze = three_nodes
+    w2.metrics.inc("worker.mine_rpcs", 9)
+    scraper = FleetScraper([
+        NodeTarget(coord.addr, "coord", "coordinator"),
+        NodeTarget(w1.addr, "w1", "worker"),
+        NodeTarget(w2.addr, "w2", "worker"),
+    ], deadline_s=5.0)
+    try:
+        first = scraper.sweep()
+        assert first["stale_nodes"] == []
+        freeze.set()
+        t0 = time.monotonic()
+        snap = scraper.sweep(deadline_s=1.0)
+        wall = time.monotonic() - t0
+        assert wall < 3.0, f"sweep did not respect its deadline: {wall}"
+        assert snap["stale_nodes"] == ["w2"]
+        meta = snap["per_node"]["w2"]
+        assert meta["status"] == "stale"
+        assert meta["age_s"] is not None and meta["age_s"] >= 0.9
+        # last-seen data still contributes, flagged
+        assert snap["counters"]["worker.mine_rpcs"] == 9
+        # and the others answered normally
+        assert snap["per_node"]["coord"]["status"] == "ok"
+        # recovery: unfreeze -> next sweep is clean again
+        freeze.clear()
+        # the abandoned poll thread still owns w2's poll lock for up to
+        # 60s of its frozen call; a RECOVERING scrape may need a fresh
+        # connection — give it a couple of sweeps
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            snap = scraper.sweep(deadline_s=1.0)
+            if not snap["stale_nodes"]:
+                break
+            time.sleep(0.2)
+    finally:
+        scraper.close()
+
+
+def test_scraper_never_seen_node_is_stale_with_null_age():
+    scraper = FleetScraper([
+        NodeTarget("127.0.0.1:1", "ghost", "worker"),  # nothing listens
+    ], deadline_s=1.0)
+    try:
+        snap = scraper.sweep()
+    finally:
+        scraper.close()
+    assert snap["stale_nodes"] == ["ghost"]
+    assert snap["per_node"]["ghost"]["age_s"] is None
+    assert snap["per_node"]["ghost"]["error"]
+
+
+def test_scraper_rejects_duplicate_names_and_empty():
+    with pytest.raises(ValueError):
+        FleetScraper([])
+    with pytest.raises(ValueError):
+        FleetScraper([NodeTarget("a:1", "x"), NodeTarget("b:2", "x")])
+
+
+@pytest.mark.slow
+def test_scraper_survives_sigstopped_worker_process():
+    """ISSUE 8 acceptance: a worker PROCESS frozen with SIGSTOP (TCP
+    accepted by the kernel, nothing answers) must not stall the sweep —
+    it completes within its shared deadline, the node reports stale,
+    and the SLO verdict still renders."""
+    coord = _StatsNode("coordinator")
+    coord.metrics.observe("coord.mine_s.miss", 0.05)
+    child = subprocess.Popen(
+        [sys.executable,
+         os.path.join(REPO, "tests", "stopped_worker_child.py"),
+         coord.addr],
+        stdout=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    try:
+        line = child.stdout.readline()
+        assert line.startswith("WORKER_READY"), line
+        worker_addr = line.split()[1]
+        scraper = FleetScraper([
+            NodeTarget(coord.addr, "coord", "coordinator"),
+            NodeTarget(worker_addr, "stopworker", "worker"),
+        ], deadline_s=5.0)
+        try:
+            first = scraper.sweep()
+            assert first["stale_nodes"] == []
+            os.kill(child.pid, signal.SIGSTOP)
+            time.sleep(0.2)
+            t0 = time.monotonic()
+            snap = scraper.sweep(deadline_s=1.5)
+            wall = time.monotonic() - t0
+            assert wall < 4.0, f"sweep stalled on the frozen worker: {wall}"
+            assert snap["stale_nodes"] == ["stopworker"]
+            # the SLO verdict still renders over the degraded view
+            engine = SLOEngine(load_slo_config(
+                os.path.join(REPO, "config", "slo.json")))
+            verdict = engine.evaluate(snap, breach_hooks=False)
+            assert verdict.status in ("pass", "warn")
+            assert verdict.stale_nodes == ["stopworker"]
+            assert "stopworker" in verdict.render()
+        finally:
+            scraper.close()
+    finally:
+        try:
+            os.kill(child.pid, signal.SIGCONT)
+        except ProcessLookupError:
+            pass
+        child.kill()
+        child.wait(timeout=10)
+        coord.close()
+
+
+# -- SLO config validation ---------------------------------------------------
+
+def _cfg(objectives, **windows):
+    cfg = {"objectives": objectives}
+    if windows:
+        cfg["windows"] = windows
+    return load_slo_config(cfg)
+
+
+def test_slo_config_unknown_histogram_rejected():
+    with pytest.raises(SLOConfigError, match="unknown histogram"):
+        _cfg([{"name": "x", "histogram": "coord.mine_s.typo", "max": 1}])
+
+
+def test_slo_config_unknown_counter_rejected():
+    with pytest.raises(SLOConfigError, match="unknown counter"):
+        _cfg([{"name": "x", "max": 1,
+               "ratio": {"num": "rpc.handler_errorz",
+                         "den": "coord.mine_rpcs"}}])
+
+
+def test_slo_config_prefix_families_accepted():
+    cfg = _cfg([
+        {"name": "m", "histogram": "worker.solve_s.sha1", "max": 1},
+        {"name": "r", "histogram": "rpc.server.dispatch_s.C.Mine",
+         "max": 1},
+    ])
+    assert len(cfg.objectives) == 2
+
+
+def test_slo_config_shape_errors():
+    with pytest.raises(SLOConfigError, match="duplicate"):
+        _cfg([{"name": "x", "histogram": "powlib.mine_s", "max": 1},
+              {"name": "x", "histogram": "powlib.mine_s", "max": 2}])
+    with pytest.raises(SLOConfigError, match="exactly one"):
+        _cfg([{"name": "x", "max": 1}])
+    with pytest.raises(SLOConfigError, match="unknown stat"):
+        _cfg([{"name": "x", "histogram": "powlib.mine_s", "stat": "p42",
+               "max": 1}])
+    with pytest.raises(SLOConfigError, match="must be positive"):
+        _cfg([{"name": "x", "histogram": "powlib.mine_s", "max": 0}])
+    with pytest.raises(SLOConfigError, match="per_model"):
+        _cfg([{"name": "x", "histogram": "powlib.mine_s", "max": 1,
+               "per_model": True}])
+    with pytest.raises(SLOConfigError, match="fast_s"):
+        _cfg([{"name": "x", "histogram": "powlib.mine_s", "max": 1}],
+             fast_s=100.0, slow_s=10.0)
+    with pytest.raises(SLOConfigError, match="non-empty"):
+        load_slo_config({"objectives": []})
+
+
+def test_checked_in_slo_config_loads():
+    cfg = load_slo_config(os.path.join(REPO, "config", "slo.json"))
+    names = [o.name for o in cfg.objectives]
+    assert "mine_e2e_p95_s" in names and "rpc_error_rate" in names
+
+
+# -- SLO verdict edges and burn-rate windows ---------------------------------
+
+def _merged(ts, miss_samples=(), errors=0, mines=0):
+    return {
+        "ts": ts,
+        "counters": {"rpc.handler_errors": errors,
+                     "coord.mine_rpcs": mines},
+        "histograms": {"coord.mine_s.miss": hist_dict(list(miss_samples))},
+        "stale_nodes": [],
+    }
+
+
+LAT_CFG = {"windows": {"fast_s": 60, "slow_s": 300},
+           "objectives": [{"name": "p95", "histogram": "coord.mine_s.miss",
+                           "stat": "p95", "max": 1.0}]}
+ERR_CFG = {"windows": {"fast_s": 60, "slow_s": 300},
+           "objectives": [{"name": "err", "max": 0.1,
+                           "ratio": {"num": "rpc.handler_errors",
+                                     "den": "coord.mine_rpcs"}}]}
+
+
+def test_verdict_pass_and_exit_zero():
+    engine = SLOEngine(load_slo_config(LAT_CFG))
+    v = engine.evaluate(_merged(1000.0, [0.1, 0.2]), breach_hooks=False)
+    assert v.status == "pass" and v.exit_code() == 0
+
+
+def test_verdict_cumulative_breach_on_single_snapshot():
+    """One-shot CI evaluation: both windows degrade to cumulative, so a
+    single over-threshold snapshot is a sustained breach."""
+    engine = SLOEngine(load_slo_config(LAT_CFG))
+    v = engine.evaluate(_merged(1000.0, [5.0] * 20), breach_hooks=False)
+    assert v.status == "breach" and v.exit_code() == 1
+
+
+def test_verdict_fast_spike_is_warn_not_breach():
+    """Burn-rate windows: a spike inside the fast window with a healthy
+    slow window warns — paging on every blip is how pages get ignored."""
+    engine = SLOEngine(load_slo_config(ERR_CFG))
+    t0 = 10_000.0
+    # deep history: 400s of healthy traffic (slow window looks good)
+    engine.observe(_merged(t0 - 400, errors=0, mines=1000), ts=t0 - 400)
+    engine.observe(_merged(t0 - 90, errors=5, mines=5000), ts=t0 - 90)
+    # the last 60s: 30% errors — fast window over budget
+    v = engine.evaluate(_merged(t0, errors=5 + 150, mines=5500), ts=t0,
+                        breach_hooks=False)
+    assert v.objectives[0].status == "warn"
+    assert "spike" in v.objectives[0].detail
+    assert v.exit_code() == 0
+
+
+def test_verdict_sustained_burn_is_breach():
+    engine = SLOEngine(load_slo_config(ERR_CFG))
+    t0 = 10_000.0
+    engine.observe(_merged(t0 - 400, errors=0, mines=1000), ts=t0 - 400)
+    engine.observe(_merged(t0 - 90, errors=800, mines=3000), ts=t0 - 90)
+    v = engine.evaluate(_merged(t0, errors=1400, mines=5000), ts=t0,
+                        breach_hooks=False)
+    assert v.objectives[0].status == "breach"
+    assert v.exit_code() == 1
+    assert v.objectives[0].burn is not None and v.objectives[0].burn > 1
+
+
+def test_verdict_recovering_slow_window_is_warn():
+    """Errors stopped recently: slow window still over, fast clean."""
+    engine = SLOEngine(load_slo_config(ERR_CFG))
+    t0 = 10_000.0
+    engine.observe(_merged(t0 - 400, errors=0, mines=1000), ts=t0 - 400)
+    engine.observe(_merged(t0 - 90, errors=900, mines=3000), ts=t0 - 90)
+    v = engine.evaluate(_merged(t0, errors=900, mines=5000), ts=t0,
+                        breach_hooks=False)
+    assert v.objectives[0].status == "warn"
+    assert "recovering" in v.objectives[0].detail
+
+
+def test_verdict_no_data_passes():
+    engine = SLOEngine(load_slo_config(ERR_CFG))
+    v = engine.evaluate(_merged(1000.0, mines=0), breach_hooks=False)
+    assert v.objectives[0].status == "no_data"
+    assert v.exit_code() == 0
+
+
+def test_verdict_per_model_thresholds():
+    cfg = load_slo_config({"objectives": [
+        {"name": "serving", "histogram": "worker.solve_s", "stat": "p95",
+         "max": 1.0, "per_model": True, "models": {"sha3_256": 30.0}}]})
+    engine = SLOEngine(cfg)
+    merged = {
+        "ts": 1.0,
+        "counters": {},
+        "histograms": {
+            "worker.solve_s.md5": hist_dict([5.0] * 10),     # over default
+            "worker.solve_s.sha3_256": hist_dict([5.0] * 10),  # under its own
+        },
+        "stale_nodes": [],
+    }
+    v = engine.evaluate(merged, breach_hooks=False)
+    by_model = {o.model: o for o in v.objectives}
+    assert by_model["md5"].status == "breach"
+    assert by_model["md5"].threshold == 1.0
+    assert by_model["sha3_256"].status == "pass"
+    assert by_model["sha3_256"].threshold == 30.0
+
+
+def test_breach_records_event_and_dumps(tmp_path):
+    RECORDER.reset()
+    RECORDER.configure(dump_dir=str(tmp_path))
+    engine = SLOEngine(load_slo_config(LAT_CFG))
+    v = engine.evaluate(_merged(1000.0, [5.0] * 20))
+    assert v.status == "breach"
+    events = [e for e in RECORDER.recent() if e["kind"] == "slo.breach"]
+    assert len(events) == 1
+    assert events[0]["objective"] == "p95"
+    assert events[0]["threshold"] == 1.0
+    assert v.dump_path and os.path.exists(v.dump_path)
+    payload = json.loads(open(v.dump_path).read())
+    assert payload["extra"]["verdict"]["status"] == "breach"
+
+
+def test_breach_dump_carries_trace_profile_critical_path(tmp_path):
+    """With a telemetry journal available, the breach dump includes the
+    trace_profile per-round critical-path breakdown (slowest first)."""
+    journal = tmp_path / "coordinator.telemetry.jsonl"
+    events = [
+        {"seq": 1, "ts": 100.0, "kind": "coord.fanout", "round": "r1",
+         "nonce": "aa", "ntz": 2},
+        {"seq": 2, "ts": 100.1, "kind": "coord.first_result", "round": "r1",
+         "nonce": "aa", "ntz": 2, "worker_byte": 0, "latency_s": 0.1},
+        {"seq": 3, "ts": 100.4, "kind": "coord.cancel_complete",
+         "round": "r1", "nonce": "aa", "ntz": 2, "late_results": 0,
+         "latency_s": 0.4},
+        {"seq": 4, "ts": 101.0, "kind": "coord.fanout", "round": "r2",
+         "nonce": "bb", "ntz": 2},
+        {"seq": 5, "ts": 103.0, "kind": "coord.cancel_complete",
+         "round": "r2", "nonce": "bb", "ntz": 2, "late_results": 1,
+         "latency_s": 2.0},
+    ]
+    journal.write_text("".join(json.dumps(e) + "\n" for e in events))
+    RECORDER.reset()
+    RECORDER.configure(dump_dir=str(tmp_path))
+    engine = SLOEngine(load_slo_config(LAT_CFG),
+                       journal_path=str(journal))
+    v = engine.evaluate(_merged(1000.0, [5.0] * 20))
+    assert v.status == "breach" and v.dump_path
+    payload = json.loads(open(v.dump_path).read())
+    cp = payload["extra"]["critical_path"]
+    assert [r["round"] for r in cp] == ["r2", "r1"]  # slowest first
+    assert cp[0]["cancel_propagation_s"] == 2.0
+
+
+def test_verdict_render_and_dict_roundtrip():
+    engine = SLOEngine(load_slo_config(LAT_CFG))
+    v = engine.evaluate(_merged(1000.0, [0.1]), breach_hooks=False)
+    text = v.render()
+    assert "SLO verdict: PASS" in text and "p95" in text
+    d = v.to_dict()
+    assert d["status"] == "pass" and d["objectives"][0]["name"] == "p95"
+    json.dumps(d)  # JSON-able end to end
+
+
+# -- cluster Prometheus exposition -------------------------------------------
+
+def test_cluster_prometheus_rendering_is_valid():
+    from distpow_tpu.cli.stats import render_cluster_prometheus
+    from test_metrics import assert_valid_prometheus
+
+    m1, m2 = Metrics(), Metrics()
+    m1.inc("coord.mine_rpcs", 2)
+    m1.observe("coord.mine_s.miss", 0.2)
+    m2.observe("worker.solve_s.md5", 0.01)
+    s1, s2 = m1.snapshot(), m2.snapshot()
+    s1["role"], s2["role"] = "coordinator", "worker"
+    cluster = merge_snapshots(
+        {"c": s1, "w": s2},
+        {"c": {"status": "ok", "age_s": 0.0},
+         "w": {"status": "stale", "age_s": 12.5}},
+    )
+    text = render_cluster_prometheus(cluster)
+    assert_valid_prometheus(text)
+    assert 'distpow_node_info{role="cluster"} 1' in text
+    assert 'distpow_node_stale{node="w"} 1' in text
+    assert 'distpow_node_stale{node="c"} 0' in text
+    assert 'distpow_node_age_seconds{node="w"} 12.5' in text
+
+
+def test_auto_role_discovery_is_error_free_on_current_nodes():
+    """The Node.Stats alias (found live by the verify drive of this
+    PR): auto-role discovery against current nodes must NOT mint
+    rpc.handler_errors on the observed node — with a light-traffic
+    denominator those probe errors breached the green error-rate SLO
+    on a perfectly healthy cluster."""
+    from distpow_tpu.nodes import Coordinator, Worker
+    from distpow_tpu.runtime.config import CoordinatorConfig, WorkerConfig
+    from distpow_tpu.runtime.metrics import REGISTRY
+
+    coordinator = Coordinator(CoordinatorConfig(
+        ClientAPIListenAddr="127.0.0.1:0",
+        WorkerAPIListenAddr="127.0.0.1:0",
+        Workers=["pending:0"],
+    ))
+    client_addr, worker_api = coordinator.initialize_rpcs()
+    worker = Worker(WorkerConfig(
+        WorkerID="aliasw", ListenAddr="127.0.0.1:0", CoordAddr=worker_api,
+        Backend="python", WarmupNonceLens=[], WarmupWidths=[],
+    ))
+    worker_addr = worker.initialize_rpcs()
+    scraper = FleetScraper([
+        NodeTarget(client_addr, "coord"),   # role defaults to auto
+        NodeTarget(worker_addr, "worker"),
+    ], deadline_s=5.0)
+    try:
+        errs0 = REGISTRY.get("rpc.handler_errors")
+        snap = scraper.sweep()
+        assert snap["stale_nodes"] == []
+        assert snap["per_node"]["coord"]["role"] == "coordinator"
+        assert snap["per_node"]["worker"]["role"] == "worker"
+        assert REGISTRY.get("rpc.handler_errors") == errs0
+    finally:
+        scraper.close()
+        worker.shutdown()
+        coordinator.shutdown()

@@ -737,3 +737,70 @@ def test_pallas_mask_word_buckets_match_xla():
         for c0 in (256, 4096):
             assert int(step_p(jnp.uint32(c0))) == int(step_x(jnp.uint32(c0))), \
                 f"divergence at difficulty {d} chunk0 {c0}"
+
+
+# -- launch-geometry k selection (pure CPU math; ISSUE 8 satellite) ----------
+
+class TestPlanLaunchGeometry:
+    """Unit tests for the extracted k-selection logic — in particular
+    the advisor-r5 pow2-k fix: the power-of-two rounding only COMMITS
+    together with a batch that makes the inner loop effective, and the
+    original k is kept otherwise."""
+
+    def test_pow2_tile_is_untouched(self):
+        from distpow_tpu.backends.pallas_backend import plan_launch_geometry
+
+        # tile 8x128=1024 is a power of two: the inner-loop fixup never
+        # runs and k keeps the driver's requested (odd) multiplier
+        batch, chunks, k = plan_launch_geometry(
+            2048, 256, 1024, 4, 5, 1 << 24)
+        assert (batch, chunks, k) == (2048 * 256, 2048, 5)
+
+    def test_batch_rounds_up_to_whole_tiles(self):
+        from distpow_tpu.backends.pallas_backend import plan_launch_geometry
+
+        # 2^21 candidates at a 24-sublane (3072) tile: 682.67 tiles
+        # rounds up to a whole grid and chunks re-derive from it
+        batch, chunks, k = plan_launch_geometry(
+            8192, 256, 3072, 1, 1, 1 << 26)
+        assert batch % 3072 == 0
+        assert batch >= 8192 * 256
+        assert chunks == batch // 256
+
+    def test_pow2_rounding_commits_with_marginal_growth(self):
+        from distpow_tpu.backends.pallas_backend import plan_launch_geometry
+
+        # the sweep-best serving shape: 683 (prime) tiles, inner=4 —
+        # a whole-tile growth of ~0.15% makes the pow2 k effective
+        batch, chunks, k = plan_launch_geometry(
+            8192, 256, 3072, 4, 5, 1 << 26)
+        assert k & (k - 1) == 0, f"k={k} not a power of two"
+        assert batch % 3072 == 0 and batch % 256 == 0
+        assert batch <= 8192 * 256 * 1.03  # growth stayed marginal
+
+    def test_growth_rejected_keeps_original_k(self):
+        from distpow_tpu.backends.pallas_backend import plan_launch_geometry
+
+        # 53 tiles at tbc=160: the next inner-compatible whole-tile
+        # batch is not tbc-aligned within the <=2% cap, so the growth
+        # conditions FAIL — the original k=3 must survive (the advisor
+        # r5 regression: unconditional rounding silently halved it)
+        batch, chunks, k = plan_launch_geometry(
+            1000, 160, 3072, 4, 3, 1 << 22)
+        assert k == 3
+        assert batch == 53 * 3072  # and the batch stayed unrounded
+
+    def test_budget_clamp_holds_through_every_path(self):
+        from distpow_tpu.backends.pallas_backend import plan_launch_geometry
+
+        for target_chunks in (1000, 2000, 8192):
+            for tbc in (96, 160, 256):
+                for launch_steps in (1, 3, 5, 8):
+                    for max_launch in (1 << 22, 1 << 24):
+                        batch, chunks, k = plan_launch_geometry(
+                            target_chunks, tbc, 3072, 4, launch_steps,
+                            max_launch)
+                        assert batch * k <= max_launch, (
+                            target_chunks, tbc, launch_steps, max_launch,
+                            batch, k)
+                        assert k >= 1 and chunks >= 1
