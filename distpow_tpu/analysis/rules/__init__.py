@@ -14,12 +14,14 @@ from . import (
     serial_rpc_fanout,
     silent_except,
     trace_vocabulary,
+    unbounded_thread_spawn,
 )
 
 ALL_RULES = (
     blocking_under_lock,
     bounded_queue,
     serial_rpc_fanout,
+    unbounded_thread_spawn,
     trace_vocabulary,
     metrics_registry,
     config_key_sync,

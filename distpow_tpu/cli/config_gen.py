@@ -8,7 +8,20 @@ slot gets a fresh port.  Keeps the worker list length from the existing
 coordinator config (cmd/config-gen/main.go:51-88).
 
     python -m distpow_tpu.cli.config_gen [--config-dir DIR] [--host HOST]
-        [--workers N]
+        [--workers N] [--elastic]
+
+Emitted configs carry the full dataclass field set, so the fleet
+membership knobs (``FleetLeaseTTLS`` / ``FleetHedge`` /
+``FleetHedgeMultiple`` / ``FleetDrainTimeoutS`` on the coordinator;
+``FleetRegister`` / ``FleetHeartbeatS`` / ``FleetCalibrationS`` /
+``FleetMHS`` / ``FleetDrainTimeoutS`` on the worker — docs/FLEET.md)
+appear with their defaults and round-trip through
+``runtime/config.py`` (the config-key-sync lint rule keeps consumer
+code honest against those fields).  ``--elastic`` flips the emitted
+worker config to ``FleetRegister: true``, the shape an elastic worker
+boots from (``--listen 127.0.0.1:0`` then works: the worker registers
+its real bound port with the coordinator instead of needing a
+pre-agreed one).
 """
 
 from __future__ import annotations
@@ -38,6 +51,9 @@ def main(argv=None) -> None:
                     help="host part written into addresses ('' for bare :port)")
     ap.add_argument("--workers", type=int, default=0,
                     help="override worker count (default: keep existing)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="emit the worker config with FleetRegister=true "
+                         "(lease-based membership, docs/FLEET.md)")
     ap.add_argument("--seed", type=int, default=None)
     args = ap.parse_args(argv)
     rng = random.Random(args.seed)
@@ -80,11 +96,16 @@ def main(argv=None) -> None:
     w.TracerServerAddr = tracer_addr
     w.CoordAddr = coord_worker_addr
     w.ListenAddr = "PASS VIA COMMAND-LINE"
+    if args.elastic:
+        w.FleetRegister = True
     write_json_config(os.path.join(d, "worker_config.json"), w)
 
     print(f"wrote configs to {d}: tracer={tracer_addr} "
           f"coordinator client={coord_client_addr} worker={coord_worker_addr} "
-          f"workers={coord.Workers}")
+          f"workers={coord.Workers} "
+          f"(fleet: lease ttl {coord.FleetLeaseTTLS}s, hedge "
+          f"{'on' if coord.FleetHedge else 'off'}, elastic worker "
+          f"{'yes' if w.FleetRegister else 'no'})")
 
 
 if __name__ == "__main__":

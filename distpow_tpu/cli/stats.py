@@ -5,6 +5,8 @@ lists metrics as absent in the reference).
         [--role auto|coordinator|worker] [--prom] [--watch SECS [--count N]]
     python -m distpow_tpu.cli.stats --cluster --addr A [--addr B ...]
         [--deadline SECS] [--prom]
+    python -m distpow_tpu.cli.stats --cluster --discover COORD_ADDR
+        [--deadline SECS] [--prom]
 
 Dials the node's RPC port, calls its ``Stats`` method, and prints the
 JSON snapshot.  ``--role auto`` (default) tries the role-agnostic
@@ -22,6 +24,14 @@ that fails to answer in time is reported ``stale`` with its last-seen
 age, never waited for.  With ``--prom`` the merged series are emitted
 cluster-labelled (``distpow_node_info{node=...}`` /
 ``distpow_node_stale{node=...}`` per node rides alongside).
+
+``--discover COORD_ADDR`` replaces the hand-maintained ``--addr`` list
+with the coordinator's LIVE membership table (``Fleet.Members``,
+docs/FLEET.md): the sweep covers the coordinator plus every current
+member — static and lease-registered alike — so an elastic fleet is
+tracked automatically as workers join, drain and expire.  Extra
+``--addr`` flags still merge in (e.g. a node outside this
+coordinator's fleet).
 
 ``--prom`` renders the snapshot as Prometheus text exposition (version
 0.0.4): counters/gauges become ``distpow_<name>`` samples and every
@@ -69,6 +79,25 @@ def fetch_stats(addr: str, role: str = "auto", timeout: float = 5.0) -> dict:
         raise last
     finally:
         client.close()
+
+
+def discover_cluster_addrs(coord_addr: str, timeout: float = 5.0) -> list:
+    """Coordinator's live membership -> scrape address list
+    (``Fleet.Members``; docs/FLEET.md).  The coordinator itself leads
+    the list; every current member follows in table order.  Draining
+    members are still scraped (they serve until their lease releases);
+    expired ones are already gone from the table."""
+    client = RPCClient(coord_addr, timeout=timeout, codec="json")
+    try:
+        table = client.call("Fleet.Members", {}, timeout=timeout)
+    finally:
+        client.close()
+    addrs = [coord_addr]
+    for m in table.get("workers") or []:
+        a = m.get("addr")
+        if a and a not in addrs:
+            addrs.append(a)
+    return addrs
 
 
 def _prom_name(name: str) -> str:
@@ -184,9 +213,13 @@ def render_watch_delta(prev: dict, snap: dict) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="print a distpow node's metrics")
-    ap.add_argument("--addr", required=True, action="append",
+    ap.add_argument("--addr", action="append", default=None,
                     help="node RPC address host:port (repeatable with "
                          "--cluster; each flag may hold a comma list)")
+    ap.add_argument("--discover", metavar="COORD_ADDR", default=None,
+                    help="with --cluster: pull the scrape list from the "
+                         "coordinator's live membership table "
+                         "(Fleet.Members) instead of --addr flags")
     ap.add_argument("--role", choices=["auto", "coordinator", "worker"],
                     default="auto")
     ap.add_argument("--timeout", type=float, default=5.0)
@@ -203,13 +236,28 @@ def main(argv=None) -> int:
                     help="with --cluster: shared sweep deadline in seconds"
                          " — slower nodes are reported stale, not waited on")
     args = ap.parse_args(argv)
-    addrs = [a for flag in args.addr for a in flag.split(",") if a]
+    addrs = [a for flag in (args.addr or []) for a in flag.split(",") if a]
     if args.watch is not None and args.watch <= 0:
         ap.error("--watch SECS must be positive")
+    if args.discover and not args.cluster:
+        ap.error("--discover requires --cluster")
+    if not addrs and not args.discover:
+        ap.error("--addr (or --cluster --discover) is required")
     if args.cluster:
         if args.watch is not None:
             ap.error("--cluster does not support --watch")
         from ..obs.scrape import scrape_cluster
+
+        if args.discover:
+            try:
+                discovered = discover_cluster_addrs(
+                    args.discover, timeout=args.timeout)
+            except (OSError, RPCError, FutureTimeout) as exc:
+                print(f"error: membership discovery against "
+                      f"{args.discover} failed: {exc}", file=sys.stderr)
+                return 1
+            # explicit --addr extras merge in after the discovered set
+            addrs = discovered + [a for a in addrs if a not in discovered]
 
         cluster = scrape_cluster(addrs, deadline_s=args.deadline,
                                  role=args.role)

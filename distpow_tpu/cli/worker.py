@@ -66,7 +66,24 @@ def main(argv=None) -> None:
     if plan_spec:
         faults.install_from_spec(plan_spec)
     logging.info("worker config: %s", config)
-    Worker(config).run_forever()  # Worker() runs the multi-host bootstrap
+    worker = Worker(config)  # Worker() runs the multi-host bootstrap
+    # graceful teardown on SIGTERM/SIGINT instead of dying mid-shard:
+    # run_forever(stop) drains the fleet lease first (docs/FLEET.md —
+    # the coordinator finishes this worker's in-flight rounds before
+    # the lease releases), then stops the serving plane.  A second
+    # signal during a slow drain falls through to the default handler.
+    import signal
+    import threading
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        signal.signal(signum, signal.SIG_DFL)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    worker.run_forever(stop)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,33 @@
+"""Elastic worker-fleet membership plane (docs/FLEET.md).
+
+The reference fixes its worker set in a config file: the coordinator
+dials the list at boot and a worker can leave only by crashing.  This
+package adds lease-based membership on top of the existing RPC layer —
+
+* :mod:`.capability` — the capability advertisement a worker registers
+  with (backend, hash models, measured MH/s from a short
+  self-calibration, scheduler slot width);
+* :mod:`.membership` — the coordinator-side lease registry + the
+  ``Fleet`` RPC service (Register / Heartbeat / Drain / Members) and
+  the per-round capability-weighted shard plan;
+* :mod:`.agent` — the worker-side agent: self-calibrate, register,
+  heartbeat, re-register after a lease loss, drain on shutdown.
+
+Static config-file workers remain first-class: they boot as
+pre-registered PERMANENT leases, so existing configs, tests and golden
+traces see byte-identical behavior.
+"""
+
+from .capability import Capability, calibrate_mhs
+from .membership import FleetRegistry, FleetService, RoundPlan, WorkerLease
+from .agent import FleetAgent
+
+__all__ = [
+    "Capability",
+    "calibrate_mhs",
+    "FleetAgent",
+    "FleetRegistry",
+    "FleetService",
+    "RoundPlan",
+    "WorkerLease",
+]

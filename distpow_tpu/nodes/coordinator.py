@@ -77,6 +77,7 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Dict, List, Optional, Tuple
 
+from ..fleet.membership import FleetRegistry, FleetService, RoundPlan
 from ..parallel.partition import worker_bits as partition_worker_bits
 from ..runtime import actions as act
 from ..runtime.cache import ResultCache
@@ -216,6 +217,11 @@ class WorkerRef:
         self.addr = addr
         self.worker_byte = worker_byte
         self.client: Optional[RPCClient] = None
+        # membership state (distpow_tpu/fleet/): static config workers
+        # get a permanent lease at registry construction; elastic
+        # workers a heartbeat lease at Fleet.Register
+        self.lease = None
+        self.inflight_rounds = 0
 
 
 class CoordRPCHandler:
@@ -228,13 +234,31 @@ class CoordRPCHandler:
                  failure_probe_secs: float = 1.0,
                  sched_max_inflight: int = 0,
                  sched_retry_after_s: float = 0.5,
-                 sched_coalesce: bool = True):
+                 sched_coalesce: bool = True,
+                 lease_ttl_s: float = 10.0,
+                 hedge: bool = True,
+                 hedge_multiple: float = 3.0):
         self.tracer = tracer
         self.workers = [WorkerRef(a, i) for i, a in enumerate(worker_addrs)]
         # floor(log2(N)) with the reference's uint truncation
         # (coordinator.go:326); see parallel/partition.py for the
-        # non-power-of-two coverage discussion.
-        self.worker_bits = partition_worker_bits(len(worker_addrs))
+        # non-power-of-two coverage discussion.  A coordinator may now
+        # boot with ZERO static workers (pure-elastic fleet): the
+        # per-round plan recomputes this from the live member count.
+        self.worker_bits = (partition_worker_bits(len(worker_addrs))
+                            if worker_addrs else 0)
+        # lease-based membership plane (distpow_tpu/fleet/,
+        # docs/FLEET.md): owns self.workers (static refs become
+        # permanent leases; Fleet.Register appends heartbeat leases),
+        # retires expired leases through _mark_dead so a vanished
+        # worker rides the same orphan-reassignment path a crashed one
+        # does, and plans each round's (possibly capability-weighted)
+        # shard layout
+        self.fleet = FleetRegistry(
+            self.workers, lease_ttl_s=lease_ttl_s, hedge=hedge,
+            hedge_multiple=hedge_multiple, on_expire=self._mark_dead,
+            make_ref=WorkerRef,
+        )
         self.result_cache = ResultCache(persist_path=cache_file or None)
         # persisted boot counter prefixing round ids: zombie-vs-live round
         # resolution at workers survives backward clock steps across
@@ -312,7 +336,11 @@ class CoordRPCHandler:
         """
         reassign = self.failure_policy == "reassign"
         while True:
-            pending = [w for w in self.workers if w.client is None]
+            # snapshot: Fleet.Register may append members concurrently;
+            # draining/retired members are not (re-)dialed — they keep
+            # whatever connection their in-flight rounds already hold
+            pending = [w for w in list(self.workers)
+                       if w.client is None and self.fleet.in_service(w)]
             if not pending:
                 return
             for w in pending:
@@ -380,10 +408,13 @@ class CoordRPCHandler:
         return [(w, s) for w, s in tasks if id(w) not in dead_ids], orphans
 
     def _issue_shards(self, trace, nonce: bytes, ntz: int, tasks, shards,
-                      rid: str, model: Optional[str] = None):
+                      rid: str, model: Optional[str] = None,
+                      plan: Optional[RoundPlan] = None):
         """Place each shard on some live worker; shards that cannot be
         placed right now stay pending for the next probe round (coverage
-        is never silently dropped)."""
+        is never silently dropped).  The plan supplies each shard's
+        weighted byte range, so a reassigned shard covers the SAME
+        space on its new owner."""
         pending: List[int] = []
         for i, shard in enumerate(shards):
             placed = False
@@ -395,7 +426,7 @@ class CoordRPCHandler:
                     break
                 w = candidates[i % len(candidates)]
                 placed = self._send_mine(trace, nonce, ntz, w, shard, rid,
-                                         model)
+                                         model, plan)
                 # a failed send marked w dead; retry the rest
             if placed:
                 tasks.append((w, shard))
@@ -539,12 +570,14 @@ class CoordRPCHandler:
         return w.client.go(method, params)
 
     def _mine_params(self, trace, nonce: bytes, ntz: int, worker_byte: int,
-                     rid: str, model: Optional[str] = None) -> dict:
+                     rid: str, model: Optional[str] = None,
+                     plan: Optional[RoundPlan] = None) -> dict:
         out = {
             "nonce": bytes(nonce),
             "num_trailing_zeros": ntz,
             "worker_byte": worker_byte,
-            "worker_bits": self.worker_bits,
+            "worker_bits": plan.worker_bits if plan is not None
+            else self.worker_bits,
             "round": rid,
             "token": wire_token(trace.generate_token()),
         }
@@ -552,6 +585,12 @@ class CoordRPCHandler:
             # off-default model rides only when requested: default
             # rounds stay wire-identical to every earlier version
             out["hash_model"] = model
+        if plan is not None:
+            # capability-weighted rounds carry the shard's explicit
+            # (tb_lo, tb_count) byte range; equal-weight rounds attach
+            # nothing and the worker expands the reference algebra —
+            # frames stay wire-identical to every earlier version
+            out.update(plan.mine_extra(worker_byte))
         return out
 
     def _found_params(self, trace, nonce: bytes, ntz: int, worker_byte: int,
@@ -581,10 +620,11 @@ class CoordRPCHandler:
 
     def _send_mine(self, trace, nonce: bytes, ntz: int, w: WorkerRef,
                    worker_byte: int, rid: str,
-                   model: Optional[str] = None) -> bool:
-        """Issue one worker Mine and BLOCK for its ack (the reissue path
-        and the serial baseline); under "reassign" a failure marks the
-        worker dead and returns False instead of raising."""
+                   model: Optional[str] = None,
+                   plan: Optional[RoundPlan] = None) -> bool:
+        """Issue one worker Mine and BLOCK for its ack (the reissue,
+        hedge and serial-baseline paths); under "reassign" a failure
+        marks the worker dead and returns False instead of raising."""
         trace.record_action(
             act.CoordinatorWorkerMine(
                 nonce=nonce, num_trailing_zeros=ntz, worker_byte=worker_byte,
@@ -592,7 +632,8 @@ class CoordRPCHandler:
         )
         fut = self._go_worker(
             w, "WorkerRPCHandler.Mine",
-            self._mine_params(trace, nonce, ntz, worker_byte, rid, model),
+            self._mine_params(trace, nonce, ntz, worker_byte, rid, model,
+                              plan),
         )
         try:
             fut.result(timeout=self._call_timeout)
@@ -655,43 +696,51 @@ class CoordRPCHandler:
         return tasks, orphans
 
     def _assign_shards(self, trace, nonce: bytes, ntz: int, rid: str,
-                       model: Optional[str] = None):
+                       model: Optional[str] = None,
+                       plan: Optional[RoundPlan] = None):
         """Fan the shard per worker (coordinator.go:179-199) — every
         Mine issued as a concurrent ``go()`` future before any reply is
         awaited; under "reassign", shards of dead workers go to live
         ones (a worker can mine a foreign worker_byte — the partition
-        travels in the RPC).  Returns (tasks, pending_unplaced_shards,
+        travels in the RPC).  The worker set is the round plan's
+        membership snapshot (fleet.round_plan): static configs yield
+        the reference layout, an elastic fleet whatever is live and not
+        draining right now.  Returns (tasks, pending_unplaced_shards,
         inflight_mine_acks)."""
+        if plan is None:
+            plan = self.fleet.round_plan()
+        if not plan.entries:
+            raise RuntimeError("no live workers to mine on")
         reassign = self.failure_policy == "reassign"
         if self._serial_fanout:
             # serial baseline (bench.py --control-plane): the old
             # one-blocking-call-per-worker loop, kept measurable
             tasks: List[Tuple[WorkerRef, int]] = []
             orphans: List[int] = []
-            for w in self.workers:
-                if self._send_mine(trace, nonce, ntz, w, w.worker_byte,
-                                   rid, model):
-                    tasks.append((w, w.worker_byte))
+            for w, shard in plan.entries:
+                if self._send_mine(trace, nonce, ntz, w, shard,
+                                   rid, model, plan):
+                    tasks.append((w, shard))
                 else:
-                    orphans.append(w.worker_byte)
+                    orphans.append(shard)
             tasks, pending = self._issue_shards(
-                trace, nonce, ntz, tasks, orphans, rid, model
+                trace, nonce, ntz, tasks, orphans, rid, model, plan
             )
             if not tasks:
                 raise RuntimeError("no live workers to mine on")
             return tasks, pending, []
         futs = []
-        for w in self.workers:
+        for w, shard in plan.entries:
             trace.record_action(
                 act.CoordinatorWorkerMine(
                     nonce=nonce, num_trailing_zeros=ntz,
-                    worker_byte=w.worker_byte,
+                    worker_byte=shard,
                 )
             )
-            futs.append((w, w.worker_byte, self._go_worker(
+            futs.append((w, shard, self._go_worker(
                 w, "WorkerRPCHandler.Mine",
-                self._mine_params(trace, nonce, ntz, w.worker_byte, rid,
-                                  model),
+                self._mine_params(trace, nonce, ntz, shard, rid,
+                                  model, plan),
             )))
         if not reassign:
             # reference parity ("error"): every worker must take
@@ -725,7 +774,7 @@ class CoordRPCHandler:
                 tasks.append((w, shard))
                 inflight.append((w, shard, fut, deadline))
         tasks, pending = self._issue_shards(
-            trace, nonce, ntz, tasks, orphans, rid, model
+            trace, nonce, ntz, tasks, orphans, rid, model, plan
         )
         if not tasks:
             raise RuntimeError("no live workers to mine on")
@@ -746,19 +795,29 @@ class CoordRPCHandler:
         self._task_set(key, rid, results)
         reassign = self.failure_policy == "reassign"
         probe_t = self.failure_probe_secs if reassign else None
+        # the round's membership snapshot (docs/FLEET.md): who gets a
+        # shard, at which worker_bits, over which (weighted) byte
+        # ranges.  Hedging appends duplicate placements to it, so the
+        # closing track_round(-1) releases every ref the round touched
+        # — the drain RPC waits on exactly this accounting.
+        plan = self.fleet.round_plan()
+        self.fleet.track_round([w for w, _ in plan.entries], +1)
         try:
             return self._mine_miss_locked(
-                trace, nonce, ntz, results, reassign, probe_t, rid, model
+                trace, nonce, ntz, results, reassign, probe_t, rid, model,
+                plan,
             )
         finally:
             # every exit path (success, protocol violation, all-workers-
             # dead, error-policy RPC failure) must release the task entry,
             # or retries leak queues and late Results route to a zombie
             self._task_delete(key)
+            self.fleet.track_round([w for w, _ in plan.entries], -1)
 
     def _mine_miss_locked(self, trace, nonce: bytes, ntz: int, results,
                           reassign: bool, probe_t, rid: str,
-                          model: Optional[str] = None) -> dict:
+                          model: Optional[str] = None,
+                          plan: Optional[RoundPlan] = None) -> dict:
         metrics.inc("coord.fanouts")
         # the fan-out instant anchors this round's two latency
         # distributions: fanout->first-result (the race the paper's
@@ -766,14 +825,20 @@ class CoordRPCHandler:
         fanout_t0 = time.monotonic()
         RECORDER.record("coord.fanout", round=rid, nonce=nonce.hex(),
                         ntz=ntz)
+        if plan is None:
+            plan = self.fleet.round_plan()
         tasks, pending, inflight = self._assign_shards(trace, nonce, ntz, rid,
-                                                       model)
+                                                       model, plan)
 
         # first-result-wins (coordinator.go:202-206); under "reassign",
-        # waiting is interleaved with liveness probes AND the harvest of
-        # the parallel fan-out's outstanding Mine acks; orphaned and
-        # not-yet-placed shards are re-issued every round so coverage is
-        # never silently lost
+        # waiting is interleaved with liveness probes, the harvest of
+        # the parallel fan-out's outstanding Mine acks AND the straggler
+        # hedge (docs/FLEET.md: a shard whose heartbeat-lease owner has
+        # gone silent past the fleet's hedge threshold gets a duplicate
+        # on the least-loaded live worker — first result still wins);
+        # orphaned and not-yet-placed shards are re-issued every round
+        # so coverage is never silently lost
+        hedged: set = set()
         while True:
             try:
                 first = results.get(timeout=probe_t)
@@ -785,8 +850,10 @@ class CoordRPCHandler:
                     raise RuntimeError("all workers died while mining")
                 tasks, pending = self._issue_shards(
                     trace, nonce, ntz, tasks, pending + hung + orphans, rid,
-                    model
+                    model, plan
                 )
+                tasks = self._maybe_hedge(trace, nonce, ntz, tasks, rid,
+                                          model, plan, hedged)
         first_result_s = time.monotonic() - fanout_t0
         metrics.observe("coord.first_result_s", first_result_s)
         RECORDER.record("coord.first_result", round=rid,
@@ -860,7 +927,13 @@ class CoordRPCHandler:
 
         if reassign:
             alive = {id(w) for w, _ in tasks}
-            abandoned = [w for w in self.workers if id(w) not in alive]
+            # only workers THIS round touched (the plan, hedges
+            # included) need the re-sync: a member that joined after
+            # fan-out has no orphaned miners to unblock, and Found-ing
+            # it would just mint unknown-task noise at its forwarder
+            abandoned = [w for w in
+                         {id(x): x for x, _ in plan.entries}.values()
+                         if id(w) not in alive]
             if abandoned:
                 # OFF the success-reply critical path (ISSUE 5 satellite:
                 # the inline re-dial used to sit between the drained
@@ -874,11 +947,70 @@ class CoordRPCHandler:
                 ).start()
         return self._success_reply(trace, nonce, ntz, winner)
 
+    def _maybe_hedge(self, trace, nonce: bytes, ntz: int, tasks, rid: str,
+                     model: Optional[str], plan: RoundPlan,
+                     hedged: set):
+        """Straggler hedging (docs/FLEET.md "Hedging policy"): while the
+        round waits for its first result, any shard whose owner's
+        heartbeat lease has gone silent for longer than
+        ``hedge_multiple x`` the fleet's median heartbeat interval gets
+        ONE duplicate Mine on the least-loaded live worker.  First
+        result still wins; the straggler is neither killed nor
+        abandoned — if it wakes and answers first, its result counts.
+        Static (permanent-lease) workers never trip this: they have no
+        heartbeats, and their failure detection stays the probe path.
+        The PR 5 SIGSTOP machinery is exactly the scenario this makes
+        first-class: a frozen worker's beats stop long before its TCP
+        shows anything wrong."""
+        if not self.fleet.hedge_enabled or self.failure_policy != "reassign":
+            return tasks
+        threshold = self.fleet.hedge_after_s()
+        loads: Dict[int, int] = {}
+        for x, _s in tasks:
+            loads[id(x)] = loads.get(id(x), 0) + 1
+        for w, shard in list(tasks):
+            if shard in hedged or not self.fleet.is_stale(w, threshold):
+                continue
+            candidates = [
+                x for x in {id(x): x for x, _ in tasks}.values()
+                if x is not w and x.client is not None
+                and not self.fleet.is_stale(x, threshold)
+                and self.fleet.in_service(x)
+            ]
+            if not candidates:
+                continue
+            target = min(candidates, key=lambda x: loads.get(id(x), 0))
+            if not self._send_mine(trace, nonce, ntz, target, shard, rid,
+                                   model, plan):
+                continue
+            hedged.add(shard)
+            tasks.append((target, shard))
+            # the duplicate placement joins the plan so the round's
+            # closing track_round(-1) and the re-sync sweep see it
+            plan.entries.append((target, shard))
+            loads[id(target)] = loads.get(id(target), 0) + 1
+            metrics.inc("fleet.hedged_shards")
+            RECORDER.record(
+                "fleet.hedge", round=rid, shard=shard,
+                owner_byte=w.worker_byte, target_byte=target.worker_byte,
+                threshold_s=round(threshold, 3),
+            )
+            log.info("hedged shard %d of silent worker %d onto worker %d",
+                     shard, w.worker_byte, target.worker_byte)
+        return tasks
+
     #: total wall-clock budget for one round's abandoned-worker re-sync
     #: (dials + Found calls share it); generous vs the 2 s dial timeout
     #: yet small enough that a stack teardown never waits on stragglers
     RESYNC_CAP_S = 8.0
     RESYNC_DIAL_TIMEOUT_S = 2.0
+
+    #: Found-ack patience for a member whose heartbeat lease is already
+    #: hedge-stale: it is almost certainly frozen, and the full shared
+    #: ``_call_timeout`` would gate the round's reply on a worker the
+    #: fleet has stopped believing in.  Never applied to permanent
+    #: (static) leases — they cannot be stale.
+    STALE_ACK_TIMEOUT_S = 1.0
 
     def _resync_abandoned(self, trace, nonce: bytes, ntz: int,
                           secret: bytes, workers: List[WorkerRef],
@@ -948,6 +1080,11 @@ class CoordRPCHandler:
                 )
 
         for w in workers:
+            # distpow: ok unbounded-thread-spawn -- bounded: one spawn
+            # per abandoned worker of ONE round (<= fleet size), and
+            # every thread self-terminates within RESYNC_CAP_S via the
+            # shared deadline — the per-item spawn is the point (the
+            # serial alternative re-serializes dial timeouts)
             threading.Thread(target=resync_one, args=(w,), daemon=True,
                              name=f"resync-{rid[-8:]}-w{w.worker_byte}"
                              ).start()
@@ -1006,6 +1143,11 @@ class CoordRPCHandler:
         for w, shard, fut in issued:
             timeout = (None if deadline is None
                        else max(0.0, deadline - time.monotonic()))
+            if timeout is not None and self.fleet.is_stale(w):
+                # hedge-stale member: clamp its ack patience so a
+                # frozen straggler cannot gate the winner's reply on
+                # the full shared deadline (STALE_ACK_TIMEOUT_S)
+                timeout = min(timeout, self.STALE_ACK_TIMEOUT_S)
             if self._await_found(w, shard, fut, timeout):
                 delivered.append((w, shard))
         return delivered
@@ -1072,8 +1214,15 @@ class CoordRPCHandler:
         snap["workers"] = [
             {"worker_byte": w.worker_byte, "addr": w.addr,
              "connected": w.client is not None}
-            for w in self.workers
+            for w in list(self.workers)
         ]
+        # live membership table (docs/FLEET.md): what `stats --discover`
+        # and Fleet.Members render — leases, capabilities, drain state
+        snap["fleet"] = {
+            "members": self.fleet.members(),
+            "lease_ttl_s": self.fleet.lease_ttl_s,
+            "hedge": self.fleet.hedge_enabled,
+        }
         snap["active_tasks"] = len(self._tasks)
         snap["cache_entries"] = len(self.result_cache)
         snap["failure_policy"] = self.failure_policy
@@ -1112,9 +1261,21 @@ class Coordinator:
             sched_max_inflight=getattr(config, "SchedMaxInflight", 0),
             sched_retry_after_s=getattr(config, "SchedRetryAfterS", 0.5),
             sched_coalesce=getattr(config, "SchedCoalesce", True),
+            lease_ttl_s=getattr(config, "FleetLeaseTTLS", 10.0) or 10.0,
+            hedge=bool(getattr(config, "FleetHedge", True)),
+            hedge_multiple=getattr(config, "FleetHedgeMultiple", 3.0) or 3.0,
         )
         self.server = RPCServer()
         self.server.register("CoordRPCHandler", self.handler)
+        # lease-based membership RPCs (distpow_tpu/fleet/, docs/FLEET.md):
+        # elastic workers Register/Heartbeat/Drain against either
+        # listener; Members feeds `stats --discover`
+        self.server.register(
+            "Fleet",
+            FleetService(self.handler.fleet,
+                         drain_timeout_s=getattr(
+                             config, "FleetDrainTimeoutS", 20.0) or 20.0),
+        )
         # role-agnostic Stats alias (distpow_tpu/obs/, docs/SLO.md):
         # lets the fleet scraper's auto-role discovery resolve ANY
         # current node without the unknown-service error a wrong-role
@@ -1161,8 +1322,9 @@ class Coordinator:
         threading.Event().wait()
 
     def shutdown(self) -> None:
+        self.handler.fleet.close()  # stop the lease reaper
         self.server.shutdown()
-        for w in self.handler.workers:
+        for w in list(self.handler.workers):
             if w.client is not None:
                 w.client.close()
         self.handler.result_cache.close()

@@ -268,6 +268,20 @@ class WorkerRPCHandler:
                     f"serve it from a worker whose configured backend is "
                     f"its Pallas kernel"
                 )
+        # capability-weighted rounds (docs/FLEET.md) ship the shard's
+        # byte range EXPLICITLY instead of the worker_byte/worker_bits
+        # algebra; validate at the RPC so a malformed range is an
+        # honest error reply, not a silent miner-thread death
+        tb_range = None
+        if params.get("tb_count") is not None:
+            tb_lo = int(params.get("tb_lo") or 0)
+            tb_count = int(params["tb_count"])
+            if not (0 <= tb_lo <= 255 and 1 <= tb_count <= 256 - tb_lo):
+                raise RuntimeError(
+                    f"invalid weighted shard range tb_lo={tb_lo} "
+                    f"tb_count={tb_count}"
+                )
+            tb_range = (tb_lo, tb_count)
         round_ = TaskRound(params.get("round"))
         self._task_set(key, round_)
 
@@ -280,7 +294,7 @@ class WorkerRPCHandler:
         threading.Thread(
             target=self._mine,
             args=(key, int(params["worker_bits"]), round_, trace,
-                  hash_model),
+                  hash_model, tb_range),
             daemon=True,
         ).start()
         return {}
@@ -392,7 +406,7 @@ class WorkerRPCHandler:
         self._send_result(key, None, trace, round_.round_id)
 
     def _mine(self, key: TaskKey, worker_bits: int, round_: TaskRound,
-              trace, hash_model=None) -> None:
+              trace, hash_model=None, tb_range=None) -> None:
         nonce, ntz, worker_byte = key
         t0 = time.monotonic()
         # mixed-hash requests bypass the (single-model) dominance cache
@@ -418,7 +432,14 @@ class WorkerRPCHandler:
             return (not off_model
                     and self.result_cache.satisfies(nonce, ntz) is not None)
 
-        tbs = partition.thread_bytes(worker_byte, worker_bits)
+        if tb_range is not None:
+            # weighted shard (docs/FLEET.md): the coordinator already
+            # sized this worker's slice by its advertised rate; the
+            # contiguous run feeds every backend exactly like an
+            # algebra-expanded one
+            tbs = list(range(tb_range[0], tb_range[0] + tb_range[1]))
+        else:
+            tbs = partition.thread_bytes(worker_byte, worker_bits)
         if self.scheduler is not None:
             # scheduler path: this thread only parks on the slot's
             # completion — the engine's single loop owns the device, so
@@ -467,11 +488,27 @@ class WorkerRPCHandler:
             cached = None if off_model else self.result_cache.get(
                 nonce, ntz, None)
             if cached is not None:
-                # cache-triggered stop: deliver the cached secret as this
-                # task's result so the owning request's protocol still
-                # sees a result, never a spurious first-message ACK
-                self._finish_found(key, cached, round_, trace)
-                return
+                # cache-triggered stop.  Our own round's Found is
+                # usually microseconds behind the install that stopped
+                # us — Found writes the cache BEFORE it fires the
+                # cancel event, so a cancel_check can land exactly in
+                # that window.  Give the in-flight Found a beat: if it
+                # arrives, this is an ordinary cancellation (below),
+                # not an abandonment — minting a late result here would
+                # cost the coordinator a full Found-rebroadcast round
+                # of traffic for a secret it already has.
+                if not round_.ev.wait(0.05):
+                    # genuinely abandoned (our Found never came):
+                    # deliver the cached secret as this task's result
+                    # so the owning request's protocol still sees a
+                    # result, never a spurious first-message ACK
+                    self._finish_found(key, cached, round_, trace)
+                    return
+                if round_.superseded:
+                    # a newer Mine took the key while we waited
+                    return
+                metrics.observe("worker.time_to_cancel_s",
+                                time.monotonic() - t0)
 
         # cancelled mid-search: two nil ACKs (worker.go:320-345)
         trace.record_action(
@@ -560,6 +597,13 @@ class Worker:
         self.bound_addr: Optional[str] = None
         self._forwarder: Optional[threading.Thread] = None
         self._stopping = threading.Event()
+        # elastic membership (distpow_tpu/fleet/, docs/FLEET.md):
+        # opt-in — a FleetRegister=false worker is a static config
+        # entry and behaves byte-identically to every earlier version.
+        # The agent is built lazily in start_fleet_agent() because the
+        # registration must advertise the REAL bound address.
+        self.fleet_agent = None
+        self._backend = backend
         self._start_warmup(backend)
         hang_timeout = float(getattr(config, "DeviceHangTimeoutS", 0.0) or 0.0)
         if hang_timeout > 0:
@@ -604,6 +648,50 @@ class Worker:
         self.server.serve_in_background()
         log.info("serving %s RPCs on %s", self.config.WorkerID, self.bound_addr)
         return self.bound_addr
+
+    def start_fleet_agent(self) -> None:
+        """Join the coordinator's fleet (docs/FLEET.md): self-calibrate,
+        register with the capability advertisement, keep the lease via
+        heartbeats.  No-op unless ``FleetRegister`` is set — static
+        config-file workers are pre-registered permanent leases on the
+        coordinator side and must not double-register.  Requires
+        ``initialize_rpcs`` (the advertisement carries the bound
+        address)."""
+        if self.fleet_agent is not None or \
+                not getattr(self.config, "FleetRegister", False):
+            return
+        if self.bound_addr is None:
+            raise RuntimeError("initialize_rpcs() before start_fleet_agent()")
+        from ..fleet import Capability, FleetAgent, calibrate_mhs
+
+        mhs = float(getattr(self.config, "FleetMHS", 0.0) or 0.0)
+        if mhs <= 0:
+            mhs = calibrate_mhs(
+                self._backend,
+                budget_s=float(
+                    getattr(self.config, "FleetCalibrationS", 0.2) or 0.0),
+            )
+        cap = Capability(
+            backend=self.config.Backend,
+            hash_models=tuple(dict.fromkeys(
+                [self.config.HashModel]
+                + list(getattr(self.config, "SchedHashModels", ()) or ()))),
+            mhs=mhs,
+            max_slots=(getattr(self.config, "SchedMaxSlots", 0)
+                       if (getattr(self.config, "Scheduler", "off")
+                           or "off") == "batching" else 0),
+        )
+        self.fleet_agent = FleetAgent(
+            worker_id=self.config.WorkerID,
+            coord_addr=self.config.CoordAddr,
+            listen_addr=self.bound_addr,
+            capability=cap,
+            heartbeat_s=float(
+                getattr(self.config, "FleetHeartbeatS", 0.0) or 0.0),
+            drain_timeout_s=float(
+                getattr(self.config, "FleetDrainTimeoutS", 20.0) or 20.0),
+        )
+        self.fleet_agent.start()
 
     def start_forwarder(self) -> None:
         """Drain the result queue into ``CoordRPCHandler.Result`` calls.
@@ -661,13 +749,31 @@ class Worker:
         self._forwarder = threading.Thread(target=forward, daemon=True)
         self._forwarder.start()
 
-    def run_forever(self) -> None:
+    def run_forever(self, stop: Optional[threading.Event] = None) -> None:
+        """Boot the full serving surface and park.  ``stop`` lets a
+        signal handler (cli/worker.py) request a graceful teardown —
+        fleet drain first, then shutdown; without one this never
+        returns (reference parity)."""
         self.initialize_rpcs()
         self.start_forwarder()
-        threading.Event().wait()
+        self.start_fleet_agent()
+        if stop is None:
+            threading.Event().wait()
+            return
+        stop.wait()
+        log.info("%s: stop requested; draining and shutting down",
+                 self.config.WorkerID)
+        self.shutdown()
 
     def shutdown(self) -> None:
         try:
+            if self.fleet_agent is not None:
+                # graceful leave FIRST, while the serving plane is still
+                # up: Fleet.Drain blocks (bounded) until this worker's
+                # in-flight rounds complete, so a drain mid-round
+                # finishes the shard instead of orphaning it
+                self.fleet_agent.stop(drain=True)
+                self.fleet_agent = None
             self._stopping.set()
             if self.scheduler is not None:
                 # first: parked miner threads unblock (their slots

@@ -77,6 +77,90 @@ def split_thread_bytes(tbs: Sequence[int], num_shards: int) -> List[List[int]]:
     return shards
 
 
+def weighted_ranges(weights: Sequence[float]) -> "List[tuple[int, int]]":
+    """Capability-weighted prefix split: per-worker ``(tb_lo, count)``
+    contiguous first-byte ranges, sized proportionally to ``weights``
+    (docs/FLEET.md "Weighted partition math").
+
+    The reference has exactly one split — equal shares through the
+    ``worker_bits``/``%9`` algebra above — so a 6 MH/s CPU worker and a
+    TPU batching worker own the same slice of the first-byte space and
+    the round ends when the SLOWEST shard's owner reports.  This split
+    sizes each worker's slice by its advertised throughput (measured
+    MH/s from the fleet capability advertisement) so expected
+    per-shard wall-clock evens out.
+
+    Contract:
+
+    * **Equal weights reproduce the reference split byte-for-byte** —
+      including the non-power-of-two uint8 wrap/overlap quirk and the
+      ``% 9`` regime (bug-for-bug; see the module docstring).  A fleet
+      with no capability spread is wire-identical to every earlier
+      version.
+    * Unequal weights yield a DISJOINT contiguous cover of the full
+      0..255 space (largest-remainder apportionment): no overlap, no
+      gap, and every positive-weight worker owns at least one byte —
+      a zero-width shard would silently drop a worker from the race.
+    * Weights must be positive and finite; > 256 workers cannot each
+      own a byte, so that is an error (the reference algebra above
+      keeps covering that regime via overlap).
+    """
+    ws = [float(w) for w in weights]
+    n = len(ws)
+    if n == 0:
+        raise ValueError("weighted_ranges needs at least one weight")
+    if any(w <= 0 or w != w or w == math.inf for w in ws):
+        raise ValueError(f"weights must be positive and finite: {ws}")
+    if all(w == ws[0] for w in ws):
+        # the reference's equal split IS the equal-weight special case:
+        # reuse the quirk-preserving algebra verbatim (overlap included)
+        bits = worker_bits(n)
+        out = []
+        for wb in range(n):
+            tbs = thread_bytes(wb, bits)
+            out.append((tbs[0], len(tbs)))
+        return out
+    if n > 256:
+        raise ValueError(
+            f"cannot give {n} workers disjoint non-empty byte ranges"
+        )
+    total = sum(ws)
+    shares = [w / total * 256.0 for w in ws]
+    # math.floor, not int(): plain host floats, but the relaunch-loop-
+    # sync rule reads int(name)-in-comprehension as a device sync
+    counts = [math.floor(s) for s in shares]
+    # every positive weight owns at least one byte before remainders
+    for i in range(n):
+        if counts[i] == 0:
+            counts[i] = 1
+    # largest-remainder apportionment of whatever is left (the floor +
+    # minimum-1 adjustments may over- or under-shoot 256; correct by
+    # remainder order, never below 1)
+    def _adjust() -> None:
+        delta = 256 - sum(counts)
+        order = sorted(range(n), key=lambda i: shares[i] - int(shares[i]),
+                       reverse=delta > 0)
+        j = 0
+        while delta != 0:
+            i = order[j % n]
+            if delta > 0:
+                counts[i] += 1
+                delta -= 1
+            elif counts[i] > 1:
+                counts[i] -= 1
+                delta += 1
+            j += 1
+
+    _adjust()
+    assert sum(counts) == 256 and all(c >= 1 for c in counts)
+    out = []
+    lo = 0
+    for c in counts:
+        out.append((lo, c))
+        lo += c
+    return out
+
+
 def contiguous_bounds(thread_bytes: Sequence[int]) -> "tuple[int, int]":
     """(tb_lo, count) for a contiguous ascending thread-byte run.
 

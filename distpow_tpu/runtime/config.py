@@ -119,6 +119,24 @@ class CoordinatorConfig:
     # On by default: it is a scheduling upgrade of the documented
     # per-key-mutex duplicate fix with identical trace shapes.
     SchedCoalesce: bool = True
+    # --- elastic fleet (distpow_tpu/fleet/, docs/FLEET.md) ---------------
+    # Lease TTL for Fleet.Register members: a worker whose heartbeats
+    # stop for this long is retired from membership and its shards ride
+    # the existing orphan-reassignment path.  Static config workers are
+    # permanent leases and never expire.
+    FleetLeaseTTLS: float = 10.0
+    # Straggler hedging: while a round waits for its first result, a
+    # shard whose heartbeat-lease owner has been silent for longer than
+    # FleetHedgeMultiple x the fleet's median heartbeat interval gets a
+    # duplicate Mine on the least-loaded live worker (first result
+    # wins).  Only heartbeat leases can trip it, so static fleets are
+    # unaffected.
+    FleetHedge: bool = True
+    FleetHedgeMultiple: float = 3.0
+    # Bound on how long one Fleet.Drain call may wait for the leaving
+    # worker's in-flight rounds to finish before releasing the lease
+    # anyway.
+    FleetDrainTimeoutS: float = 20.0
 
 
 @dataclass
@@ -214,6 +232,26 @@ class WorkerConfig:
     # the solo path instead.  Empty = HashModel only (pre-PR-6
     # behavior: any other hash forfeits batching).
     SchedHashModels: List[str] = field(default_factory=list)
+    # --- elastic fleet (distpow_tpu/fleet/, docs/FLEET.md) ---------------
+    # Join the coordinator's fleet via Fleet.Register instead of (not in
+    # addition to) being a static entry in the coordinator's Workers
+    # list.  Off by default: static config workers must not
+    # double-register.
+    FleetRegister: bool = False
+    # Heartbeat cadence in seconds; 0 = use the coordinator's hint from
+    # the Register reply (lease TTL / 3).
+    FleetHeartbeatS: float = 0.0
+    # Budget for the boot-time MH/s self-calibration the capability
+    # advertisement carries; 0 = skip (advertise unknown, which keeps
+    # the fleet on the reference equal split).
+    FleetCalibrationS: float = 0.2
+    # Explicit advertised MH/s override (> 0 skips calibration):
+    # deterministic weights for tests and benches, or an operator who
+    # knows the hardware better than a 200 ms sample does.
+    FleetMHS: float = 0.0
+    # Bound on the graceful-drain wait at shutdown (mirrors the
+    # coordinator-side FleetDrainTimeoutS).
+    FleetDrainTimeoutS: float = 20.0
 
 
 @dataclass

@@ -76,6 +76,16 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
 * ``slo.evaluations`` / ``slo.breaches`` — SLO-engine verdict runs and
   verdicts that breached (distpow_tpu/obs/slo.py; every breach also
   records an ``slo.breach`` flight-recorder event)
+* ``fleet.joins`` — elastic workers admitted via ``Fleet.Register``
+  (re-registrations after a lost lease included;
+  distpow_tpu/fleet/membership.py, docs/FLEET.md)
+* ``fleet.lease_expiries`` — heartbeat leases retired after missing
+  their TTL (the vanished-worker path into orphan reassignment)
+* ``fleet.drains`` — leases released through the graceful
+  ``Fleet.Drain`` RPC (in-flight rounds completed first)
+* ``fleet.hedged_shards`` — straggler shards duplicated onto the
+  least-loaded live worker while a round waited on a silent owner
+  (nodes/coordinator.py ``_maybe_hedge``)
 
 Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 ``KNOWN_HISTOGRAM_PREFIXES`` vs ``observe()``/``time()`` call sites):
@@ -90,6 +100,9 @@ Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
   aggregation's per-model breakdown read these — docs/SLO.md)
 * ``obs.sweep_s``      — fleet-scraper merge time per sweep
   (distpow_tpu/obs/scrape.py)
+* ``fleet.heartbeat_rtt_s`` — worker-observed lease-heartbeat round
+  trip (distpow_tpu/fleet/agent.py; the cadence side lives in the
+  registry's per-lease EMA and drives the hedge threshold)
 * ``worker.time_to_cancel_s`` — Mine receipt to honored cancellation
 * ``search.launch_s``  — time blocked fetching one launch's result
   (the serial driver's FIFO drain; parallel/search.py)
@@ -108,7 +121,9 @@ Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 Gauges (not lint-gated — gauges are set, never minted by typo'd
 increments): ``worker.active_searches``, ``worker.mine_queue_depth``,
 ``worker.forward_queue_depth``, ``search.hashes_per_s``,
-``sched.active_slots``, ``sched.run_queue_depth``.
+``sched.active_slots``, ``sched.run_queue_depth``,
+``fleet.live_workers`` (coordinator-side count of non-draining
+members, static and elastic alike — distpow_tpu/fleet/membership.py).
 """
 
 from __future__ import annotations
@@ -149,6 +164,8 @@ KNOWN_COUNTERS = frozenset({
     "telemetry.dropped_events", "telemetry.dumps",
     "obs.scrapes", "obs.scrape_failures",
     "slo.evaluations", "slo.breaches",
+    "fleet.joins", "fleet.lease_expiries", "fleet.drains",
+    "fleet.hedged_shards",
 })
 
 # Families minted from runtime values (f-string call sites): the
@@ -169,6 +186,7 @@ KNOWN_HISTOGRAMS = frozenset({
     "sched.batch_occupancy", "sched.slot_wait_s",
     "rpc.frame.sent_bytes", "rpc.frame.recv_bytes",
     "obs.sweep_s",
+    "fleet.heartbeat_rtt_s",
 })
 
 # Per-method families (runtime/rpc.py mints one histogram per

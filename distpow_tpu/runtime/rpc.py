@@ -275,6 +275,9 @@ class RPCServer:
 
     def serve_in_background(self) -> None:
         for ls in self._listeners:
+            # distpow: ok unbounded-thread-spawn -- bounded: one
+            # acceptor per listener, and listeners are a small fixed
+            # set wired at boot (the coordinator's two)
             t = threading.Thread(target=self._accept_loop, args=(ls,), daemon=True)
             t.start()
             self._threads.append(t)
@@ -295,6 +298,10 @@ class RPCServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._lock:
                 self._conns.add(conn)
+            # distpow: ok unbounded-thread-spawn -- deliberate
+            # thread-per-connection: Go net/rpc parity (the reference's
+            # accept loop spawns a goroutine per conn), and the peer set
+            # is the cluster's node count, not open traffic
             threading.Thread(
                 target=self._conn_loop, args=(conn,), daemon=True
             ).start()
@@ -325,6 +332,12 @@ class RPCServer:
                     # exchange so nothing else can be in flight
                     self._handle_hello(conn, wlock, req, codec)
                     continue
+                # distpow: ok unbounded-thread-spawn -- deliberate
+                # goroutine-per-request parity (class docstring): a slow
+                # handler (the blocking Mine) must not head-of-line-block
+                # the connection; depth is bounded by the caller's own
+                # in-flight window, and admission control (PR 4) sheds
+                # load before this layer sees it
                 threading.Thread(
                     target=self._dispatch,
                     args=(conn, wlock, req, peer, codec),

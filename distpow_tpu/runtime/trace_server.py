@@ -82,6 +82,9 @@ class TracingServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            # distpow: ok unbounded-thread-spawn -- thread-per-node
+            # connection like the RPC server's accept loop: the tracing
+            # peers are the cluster's nodes, a small bounded set
             threading.Thread(
                 target=self._conn_loop, args=(conn,), daemon=True
             ).start()

@@ -69,6 +69,12 @@ METHODS: Tuple[str, ...] = (
     "WorkerRPCHandler.Cancel",
     "WorkerRPCHandler.Ping",
     "WorkerRPCHandler.Stats",
+    # appended for the fleet membership plane (distpow_tpu/fleet/,
+    # docs/FLEET.md); table stays append-only
+    "Fleet.Register",
+    "Fleet.Heartbeat",
+    "Fleet.Drain",
+    "Fleet.Members",
 )
 _METHOD_IDS = {m: i for i, m in enumerate(METHODS)}
 
@@ -82,6 +88,16 @@ KEYS: Tuple[str, ...] = (
     "secret",
     "codec",
     "worker_tasks",
+    # appended for the fleet membership plane (weighted shard ranges on
+    # every Mine of a weighted round; lease plumbing on the low-rate
+    # Fleet RPCs); table stays append-only
+    "tb_lo",
+    "tb_count",
+    "lease_id",
+    "worker_id",
+    "capability",
+    "ttl_s",
+    "heartbeat_s",
 )
 _KEY_IDS = {k: i for i, k in enumerate(KEYS)}
 

@@ -35,7 +35,13 @@
 # in-process cluster must pass the checked-in config/slo.json (exit 0)
 # while a tightened copy must breach (nonzero exit + slo.breach
 # flight-recorder event + ring dump) — ~15 s, CPU.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke]
+# `--fleet-smoke` runs the deterministic elastic-membership smoke
+# (scripts/fleet_smoke.py, docs/FLEET.md): two workers join by
+# Fleet.Register with a 4:1 rate skew, a round fans out weighted byte
+# ranges and solves, a frozen straggler's shard is hedged, `stats
+# --discover`'s membership pull tracks the fleet, and a drain releases
+# only after its in-flight rounds finish — ~20 s, CPU, no jax.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -88,6 +94,13 @@ if [ "${1:-}" = "--slo-smoke" ]; then
   echo "=== SLO gate smoke (open-loop load + cluster merge + breach evidence) ==="
   JAX_PLATFORMS=cpu python scripts/slo_smoke.py
   echo "=== slo smoke OK ==="
+  exit 0
+fi
+
+if [ "${1:-}" = "--fleet-smoke" ]; then
+  echo "=== fleet smoke (elastic join + weighted shards + hedge + drain) ==="
+  JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+  echo "=== fleet smoke OK ==="
   exit 0
 fi
 

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Deterministic elastic-fleet smoke (docs/FLEET.md; ci.sh --fleet-smoke).
+
+One in-process pass over the membership plane's whole lifecycle:
+
+1. boot a coordinator with ZERO static workers;
+2. register two elastic workers with a 4:1 advertised-rate skew and
+   prove a Mine round fans out capability-weighted explicit byte
+   ranges (fast worker >= 3x the space, exact disjoint cover) and
+   still verifies;
+3. freeze one worker's miner + heartbeats (the straggler probes cannot
+   see) and prove the round completes via a hedged duplicate shard;
+4. discover the membership table the way `stats --cluster --discover`
+   does and check it tracks the live fleet;
+5. drain one worker mid-traffic and prove the lease releases only
+   after its in-flight rounds complete, then the fleet serves on
+   without it.
+
+Exit 0 = every gate held.  ~20 s, pure CPU, no jax.
+"""
+
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+from fleet_helpers import ShardGatedBackend  # noqa: E402
+
+from distpow_tpu.models import puzzle  # noqa: E402
+from distpow_tpu.nodes import Client, Coordinator, Worker  # noqa: E402
+from distpow_tpu.runtime.config import (  # noqa: E402
+    ClientConfig,
+    CoordinatorConfig,
+    WorkerConfig,
+)
+from distpow_tpu.runtime.metrics import REGISTRY as metrics  # noqa: E402
+
+
+def gate(name, ok, detail=""):
+    print(f"[fleet-smoke] {'PASS' if ok else 'FAIL'}: {name}"
+          f"{' — ' + detail if detail else ''}")
+    if not ok:
+        sys.exit(1)
+
+
+def main() -> None:
+    coordinator = Coordinator(CoordinatorConfig(
+        ClientAPIListenAddr="127.0.0.1:0",
+        WorkerAPIListenAddr="127.0.0.1:0",
+        Workers=[],
+        FailurePolicy="reassign",
+        FailureProbeSecs=0.2,
+        FleetLeaseTTLS=30.0,
+        FleetHedgeMultiple=2.0,
+    ))
+    client_addr, worker_api = coordinator.initialize_rpcs()
+
+    def boot_worker(wid, mhs):
+        w = Worker(WorkerConfig(
+            WorkerID=wid, ListenAddr="127.0.0.1:0", CoordAddr=worker_api,
+            Backend="python", WarmupNonceLens=[], WarmupWidths=[],
+            FleetRegister=True, FleetHeartbeatS=0.1,
+            FleetCalibrationS=0.0, FleetMHS=mhs,
+        ))
+        w.initialize_rpcs()
+        w.start_forwarder()
+        w.start_fleet_agent()
+        assert w.fleet_agent.wait_registered(10.0), f"{wid} never joined"
+        return w
+
+    fast = boot_worker("fast", 8.0)
+    slow = boot_worker("slow", 2.0)
+    workers = [fast, slow]
+
+    seen = {}
+    for w in workers:
+        orig = w.handler.Mine
+
+        def wrapped(params, _orig=orig, _wid=w.config.WorkerID):
+            seen.setdefault(_wid, []).append(dict(params))
+            return _orig(params)
+
+        w.handler.Mine = wrapped
+
+    client = Client(ClientConfig(ClientID="smoke", CoordAddr=client_addr))
+    client.initialize()
+    try:
+        # -- weighted fan-out -------------------------------------------
+        client.mine(b"\xf1\x01", 2)
+        res = client.notify_queue.get(timeout=30)
+        gate("weighted round solves", res.error is None
+             and puzzle.check_secret(res.nonce, res.secret, 2))
+        f, s = seen["fast"][0], seen["slow"][0]
+        gate("fast worker owns >= 3x the byte space",
+             f.get("tb_count", 0) >= 3 * s.get("tb_count", 256),
+             f"fast={f.get('tb_count')} slow={s.get('tb_count')}")
+        cover = set(range(f["tb_lo"], f["tb_lo"] + f["tb_count"])) | \
+            set(range(s["tb_lo"], s["tb_lo"] + s["tb_count"]))
+        gate("weighted ranges cover the byte space exactly",
+             cover == set(range(256)))
+
+        # -- straggler hedging ------------------------------------------
+        # fast owns the low range (holds byte 0): freeze its miner and
+        # heartbeats; only a hedged duplicate can finish the round
+        fast.handler.backend = ShardGatedBackend(frozen=True)
+        slow.handler.backend = ShardGatedBackend()
+        fast.fleet_agent.pause()
+        time.sleep(0.3)
+        hedged0 = metrics.get("fleet.hedged_shards")
+        t0 = time.monotonic()
+        client.mine(b"\xf2\x02", 2)
+        res = client.notify_queue.get(timeout=20)
+        wall = time.monotonic() - t0
+        gate("hedged round solves", res.error is None
+             and puzzle.check_secret(res.nonce, res.secret, 2),
+             f"{wall:.2f}s")
+        gate("a shard was hedged",
+             metrics.get("fleet.hedged_shards") > hedged0)
+        fast.fleet_agent.resume()
+        fast.handler.backend = ShardGatedBackend()
+
+        # -- discovery --------------------------------------------------
+        from distpow_tpu.cli.stats import discover_cluster_addrs
+
+        addrs = discover_cluster_addrs(client_addr)
+        gate("discovery lists coordinator + both members",
+             len(addrs) == 3, ",".join(addrs))
+
+        # -- drain mid-traffic ------------------------------------------
+        drains0 = metrics.get("fleet.drains")
+        client.mine(b"\xf3\x03", 2)
+        out = slow.fleet_agent.stop(drain=True)
+        res = client.notify_queue.get(timeout=30)
+        gate("round spanning the drain still solves", res.error is None)
+        gate("drain completed in-flight rounds first",
+             out.get("drained") is True and not out.get("skipped"))
+        gate("drain counted", metrics.get("fleet.drains") == drains0 + 1)
+        slow.fleet_agent = None
+        members = coordinator.handler.fleet.members()
+        gate("membership tracks the departure",
+             [m.get("worker_id") for m in members] == ["fast"])
+        client.mine(b"\xf4\x04", 2)
+        res = client.notify_queue.get(timeout=30)
+        gate("fleet serves on after the drain", res.error is None
+             and puzzle.check_secret(res.nonce, res.secret, 2))
+        print("[fleet-smoke] OK")
+    finally:
+        client.close()
+        for w in workers:
+            w.shutdown()
+        coordinator.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Shared designated-finder stub for the fleet membership suites
+(tests/test_fleet.py, scripts/fleet_smoke.py).
+
+``bench.py --membership`` keeps its own inline twin deliberately — the
+bench defines one stub per stage next to the measurement it shapes
+(the ``control_plane_stage`` idiom) and must stay importable with no
+test-tree dependency.
+"""
+
+import time
+
+from distpow_tpu.models import puzzle
+
+
+class ShardGatedBackend:
+    """Solves only when its shard contains first-byte 0 (after an
+    optional, cancellation-aware delay); honors cancellation otherwise.
+    ``frozen`` wedges the miner — NOT the RPC surface — until released,
+    the alive-but-stuck straggler probes cannot see."""
+
+    def __init__(self, solve_delay_s=0.0, frozen=False):
+        self.solve_delay_s = solve_delay_s
+        self.frozen = frozen
+
+    def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
+        while self.frozen and not (cancel_check and cancel_check()):
+            time.sleep(0.01)
+        if 0 in thread_bytes and not (cancel_check and cancel_check()):
+            deadline = time.monotonic() + self.solve_delay_s
+            while time.monotonic() < deadline:
+                if cancel_check and cancel_check():
+                    return None
+                time.sleep(0.01)
+            return puzzle.python_search(nonce, difficulty, thread_bytes)
+        while not (cancel_check and cancel_check()):
+            time.sleep(0.01)
+        return None

@@ -1,0 +1,46 @@
+"""Elastic-worker child for the fleet membership tests (test_fleet.py).
+
+Boots a real python-backend Worker with ``FleetRegister`` on, registers
+against the given coordinator worker-API address, prints
+``WORKER_READY <addr>`` and serves until killed.  The parent SIGKILLs
+it mid-round (lease-expiry reassignment) or SIGSTOPs it past its lease
+TTL (ride-out + fresh re-registration) — the two membership-chaos
+scenarios that need a real process to be honest.
+
+Usage: python tests/fleet_worker_child.py <coord_worker_api_addr>
+           [<heartbeat_s>] [<worker_id>]
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distpow_tpu.nodes.worker import Worker  # noqa: E402
+from distpow_tpu.runtime.config import WorkerConfig  # noqa: E402
+
+coord_addr = sys.argv[1]
+heartbeat_s = float(sys.argv[2]) if len(sys.argv) > 2 else 0.2
+worker_id = sys.argv[3] if len(sys.argv) > 3 else "elasticworker"
+w = Worker(
+    WorkerConfig(
+        WorkerID=worker_id,
+        ListenAddr="127.0.0.1:0",
+        CoordAddr=coord_addr,
+        Backend="python",
+        WarmupNonceLens=[],
+        WarmupWidths=[],
+        FleetRegister=True,
+        FleetHeartbeatS=heartbeat_s,
+        FleetCalibrationS=0.0,  # deterministic boot: no calibration
+    )
+)
+addr = w.initialize_rpcs()
+w.start_forwarder()
+w.start_fleet_agent()
+if not w.fleet_agent.wait_registered(timeout=20.0):
+    print("REGISTER_TIMEOUT", flush=True)
+    sys.exit(3)
+print(f"WORKER_READY {addr}", flush=True)
+threading.Event().wait()

@@ -1,0 +1,21 @@
+"""Fixture: unbounded-thread-spawn must fire on each spawn-in-loop."""
+
+import threading
+from threading import Thread
+
+
+def heartbeat_all(members):
+    for m in members:  # one thread per member: scales with the fleet
+        threading.Thread(target=m.beat, daemon=True).start()
+
+
+def poll_forever(queue_):
+    while True:  # one thread per message: scales with traffic
+        msg = queue_.get()
+        Thread(target=print, args=(msg,)).start()
+
+
+def nested_only_reports_once(batches):
+    for batch in batches:
+        for item in batch:  # anchors to THIS (innermost) loop only
+            threading.Thread(target=item.run).start()

@@ -1,0 +1,36 @@
+"""Fixture: the sanctioned shapes must stay clean."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def persistent_loop(members):
+    def run():
+        for m in members:
+            m.beat()
+
+    # ONE thread outside the loop; the loop lives inside it
+    threading.Thread(target=run, daemon=True).start()
+
+
+def pooled(members):
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        for m in members:  # the pool bounds concurrency, not the loop
+            pool.submit(m.beat)
+
+
+def callback_defined_in_loop(members):
+    handlers = []
+    for m in members:
+        # a thread DEFINED (not started) per item is a closure, and the
+        # nested-function body is outside the loop's dynamic extent
+        def later(m=m):
+            threading.Thread(target=m.beat).start()
+
+        handlers.append(later)
+    return handlers
+
+
+def suppressed_bounded(members):
+    for m in members[:4]:
+        threading.Thread(target=m.beat).start()  # distpow: ok unbounded-thread-spawn -- bounded: the slice caps this at 4 spawns per call, fixture for the suppression protocol

@@ -448,3 +448,62 @@ def test_finalize_control_plane_headline_attaches_load_slo(bench):
     assert prov is None
     assert line["unit"] == "ms"
     assert line["load_slo"] == LS
+
+
+# -- membership stage (ISSUE 12) ---------------------------------------------
+
+MB = {
+    "solve_delay_s": 1.0,
+    "reassignment": {
+        "lease_expiry": {"healthy_s": 1.0, "dead_worker_s": 1.7,
+                         "detection_overhead_s": 0.7},
+        "probe_baseline": {"healthy_s": 1.0, "dead_worker_s": 3.3,
+                           "detection_overhead_s": 2.3},
+        "lease_vs_probe_x": 3.29,
+    },
+    "straggler": {"n_workers": 4, "cap_s": 8.0, "healthy_s": 1.0,
+                  "hedged_s": 1.3, "hedge_off_s": None,
+                  "hedge_off_floor_s": 8.0, "hedged_vs_healthy_x": 1.3},
+    "hedge_within_2x_healthy": True,
+}
+
+
+def test_finalize_attaches_membership_row(bench):
+    """The membership stage rides both artifacts of a normal run, like
+    the other tunnel-independent rows."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_FULL, 5.35e6, membership=MB
+    )
+    assert line["membership"] == MB
+    assert prov["membership"] == MB
+    assert line["unit"] == "MH/s"
+
+
+def test_finalize_membership_only_run(bench):
+    """bench.py --membership: the headline is the hedged straggler
+    round completion and kernel provenance is NOT re-stamped."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None, membership=MB)
+    assert prov is None
+    assert line["unit"] == "s"
+    assert line["value"] == 1.3
+    assert line["vs_baseline"] == 1.3  # hedged-vs-healthy ratio
+    assert "hedging on" in line["metric"]
+    assert line["membership"] == MB
+
+
+def test_finalize_carries_forward_membership(bench):
+    lm = dict(LAST_FULL, membership=MB)
+    line, prov = bench.finalize_record({"serving": 9800.0e6}, lm, 5.35e6)
+    assert prov["membership"] == MB
+    assert "membership" not in line
+
+
+def test_finalize_control_plane_headline_attaches_membership(bench):
+    """Device-unreachable runs that measured both CPU stages: the
+    control-plane row stays the headline, membership rides along."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, control_plane=CP, membership=MB
+    )
+    assert prov is None
+    assert line["unit"] == "ms"
+    assert line["membership"] == MB

