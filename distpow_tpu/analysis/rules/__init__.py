@@ -15,6 +15,7 @@ from . import (
     silent_except,
     trace_vocabulary,
     unbounded_thread_spawn,
+    unclosed_span,
 )
 
 ALL_RULES = (
@@ -27,6 +28,7 @@ ALL_RULES = (
     config_key_sync,
     hot_path_host_sync,
     relaunch_loop_sync,
+    unclosed_span,
     silent_except,
     dead_package,
 )

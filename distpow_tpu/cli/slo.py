@@ -62,7 +62,10 @@ def main(argv=None) -> int:
         print(f"slo config error: {exc}", file=sys.stderr)
         return 2
 
-    engine = SLOEngine(config)
+    # span_addrs: on breach the engine sweeps these nodes' Node.Spans
+    # for the slow-request timelines — this gate process has no local
+    # span ring of its own (docs/FORENSICS.md)
+    engine = SLOEngine(config, span_addrs=addrs)
     scraper = FleetScraper(
         [NodeTarget(addr=a, role=args.role) for a in addrs],
         deadline_s=args.deadline,

@@ -38,6 +38,10 @@ coordinator's fleet).
 histogram becomes a full ``_bucket{le=...}/_sum/_count`` family built
 from the registry's log buckets — point any Prometheus scrape job at a
 thin exporter wrapping this, or eyeball percentile movement directly.
+``--prom --openmetrics`` upgrades to OpenMetrics: buckets carry their
+retained ``{trace_id=...}`` exemplars (the forensics plane's pointer
+from "p99 moved" to the one request that landed there —
+docs/FORENSICS.md) and the exposition closes with ``# EOF``.
 ``--watch SECS`` re-fetches every SECS seconds and prints counter
 deltas plus live histogram quantiles (``--count N`` bounds the
 refreshes; default unbounded, Ctrl-C exits).  docs/METRICS.md is the
@@ -116,12 +120,18 @@ def _prom_num(v) -> str:
     return repr(f)
 
 
-def render_prometheus(snap: dict) -> str:
+def render_prometheus(snap: dict, openmetrics: bool = False) -> str:
     """Snapshot -> Prometheus text exposition (0.0.4).
 
     Histograms are re-emitted cumulatively from the snapshot's
     non-cumulative log buckets (runtime/metrics.py Histogram.to_dict),
     closed by the mandatory ``+Inf`` bucket equal to ``_count``.
+
+    ``openmetrics=True`` (``--prom --openmetrics``) upgrades the output
+    to OpenMetrics: each bucket that retains an exemplar appends the
+    ``# {trace_id="..."} value ts`` clause (docs/FORENSICS.md — the
+    pointer from a bucket to the one request that last landed there),
+    and the exposition is closed by the mandatory ``# EOF``.
     """
     out = []
     role = snap.get("role", "unknown")
@@ -142,25 +152,43 @@ def render_prometheus(snap: dict) -> str:
     for name, h in sorted((snap.get("histograms") or {}).items()):
         pname = _prom_name(name)
         out.append(f"# TYPE {pname} histogram")
+        exemplars = {}
+        if openmetrics:
+            exemplars = {_prom_num(b): (tid, v, ts)
+                         for b, tid, v, ts in h.get("exemplars", [])}
         cum = 0
         for le, count in h.get("buckets", []):
             cum += count
-            out.append(f'{pname}_bucket{{le="{_prom_num(le)}"}} {cum}')
+            line = f'{pname}_bucket{{le="{_prom_num(le)}"}} {cum}'
+            ex = exemplars.get(_prom_num(le))
+            if ex is not None:
+                tid, v, ts = ex
+                line += (f' # {{trace_id="{tid}"}} {_prom_num(v)} '
+                         f"{_prom_num(ts)}")
+            out.append(line)
         out.append(f'{pname}_bucket{{le="+Inf"}} {h["count"]}')
         out.append(f"{pname}_sum {_prom_num(h.get('sum', 0))}")
         out.append(f"{pname}_count {h['count']}")
+    if openmetrics:
+        out.append("# EOF")
     return "\n".join(out) + "\n"
 
 
-def render_cluster_prometheus(cluster: dict) -> str:
+def render_cluster_prometheus(cluster: dict, openmetrics: bool = False) -> str:
     """Merged cluster snapshot -> Prometheus text exposition.
 
     The merged counters/gauges/histograms render through the same
     single-node path (they share its snapshot shape) under
     ``role="cluster"``; per-node membership, staleness, and last-seen
     age ride as labelled gauges so one scrape shows both the cluster
-    view and which nodes it is missing."""
-    body = render_prometheus(dict(cluster, role="cluster"))
+    view and which nodes it is missing.  ``openmetrics`` appends the
+    merged exemplars to the bucket lines (render_prometheus) — the
+    ``# EOF`` terminator is re-seated after the per-node block so the
+    exposition stays well-formed."""
+    body = render_prometheus(dict(cluster, role="cluster"),
+                             openmetrics=openmetrics)
+    if openmetrics:
+        body = body.rstrip("\n").rsplit("\n# EOF", 1)[0] + "\n"
     out = [body.rstrip("\n")]
     per_node = cluster.get("per_node") or {}
     if per_node:
@@ -178,6 +206,8 @@ def render_cluster_prometheus(cluster: dict) -> str:
                 out.append(
                     f'distpow_node_age_seconds{{node="{name}"}} '
                     f"{_prom_num(age)}")
+    if openmetrics:
+        out.append("# EOF")
     return "\n".join(out) + "\n"
 
 
@@ -225,6 +255,11 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=5.0)
     ap.add_argument("--prom", action="store_true",
                     help="Prometheus text exposition instead of JSON")
+    ap.add_argument("--openmetrics", action="store_true",
+                    help="with --prom: OpenMetrics output — histogram "
+                         "buckets carry their retained trace-id "
+                         "exemplars and the exposition ends with # EOF "
+                         "(docs/FORENSICS.md)")
     ap.add_argument("--watch", type=float, metavar="SECS", default=None,
                     help="refresh every SECS seconds, printing deltas")
     ap.add_argument("--count", type=int, default=0,
@@ -241,6 +276,8 @@ def main(argv=None) -> int:
         ap.error("--watch SECS must be positive")
     if args.discover and not args.cluster:
         ap.error("--discover requires --cluster")
+    if args.openmetrics and not args.prom:
+        ap.error("--openmetrics requires --prom")
     if not addrs and not args.discover:
         ap.error("--addr (or --cluster --discover) is required")
     if args.cluster:
@@ -261,8 +298,9 @@ def main(argv=None) -> int:
 
         cluster = scrape_cluster(addrs, deadline_s=args.deadline,
                                  role=args.role)
-        text = render_cluster_prometheus(cluster) if args.prom \
-            else json.dumps(cluster, indent=2, sort_keys=True)
+        text = render_cluster_prometheus(
+            cluster, openmetrics=args.openmetrics
+        ) if args.prom else json.dumps(cluster, indent=2, sort_keys=True)
         try:
             print(text, flush=True)
         except BrokenPipeError:
@@ -296,7 +334,8 @@ def main(argv=None) -> int:
                 time.sleep(args.watch)
                 continue
             if args.prom:
-                text = render_prometheus(snap)
+                text = render_prometheus(snap,
+                                         openmetrics=args.openmetrics)
             elif args.watch is not None:
                 text = render_watch_delta(prev, snap)
             else:

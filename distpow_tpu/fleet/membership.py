@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..parallel import partition
 from ..runtime.metrics import REGISTRY as metrics
+from ..runtime.spans import SPANS
 from ..runtime.telemetry import RECORDER
 from .capability import Capability
 
@@ -316,6 +317,15 @@ class FleetRegistry:
                             worker_id=ref.lease.worker_id,
                             beat_age_s=round(now - ref.lease.last_beat, 3),
                             ttl_s=ref.lease.ttl_s)
+            # fleet-scoped forensics marker (docs/FORENSICS.md): no
+            # request in scope on the reaper thread, so this records
+            # under trace 0 — visible in the ring and in dumps, and the
+            # orphaned shards' reassignment shows up per-trace via the
+            # coord.reassign spans the next probe cycle mints
+            SPANS.event("fleet.lease_expiry", trace_id=0,
+                        worker_id=ref.lease.worker_id,
+                        worker_byte=getattr(ref, "worker_byte", None),
+                        beat_age_s=round(now - ref.lease.last_beat, 3))
             if self._on_expire is not None:
                 self._on_expire(ref)
         return expired

@@ -90,6 +90,7 @@ from ..runtime.rpc import (
     RPCTransportError,
     StatsOnly,
 )
+from ..runtime.spans import SPANS, SlowRequestTrigger
 from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, make_tracer, wire_token
 from ..sched.admission import AdmissionReject
@@ -237,7 +238,9 @@ class CoordRPCHandler:
                  sched_coalesce: bool = True,
                  lease_ttl_s: float = 10.0,
                  hedge: bool = True,
-                 hedge_multiple: float = 3.0):
+                 hedge_multiple: float = 3.0,
+                 forensics_slow_s: float = 0.0,
+                 forensics_p99x: float = 0.0):
         self.tracer = tracer
         self.workers = [WorkerRef(a, i) for i, a in enumerate(worker_addrs)]
         # floor(log2(N)) with the reference's uint truncation
@@ -293,6 +296,13 @@ class CoordRPCHandler:
         # one-blocking-call-per-worker fan-out so bench.py
         # --control-plane can measure the parallel win as a number
         self._serial_fanout = os.environ.get("DISTPOW_SERIAL_FANOUT") == "1"
+        # slow-request auto-capture (runtime/spans.py, docs/FORENSICS.md):
+        # a completed miss past the fixed budget — or past the rolling
+        # p99 exceedance — snapshots its span tree into the flight
+        # recorder, so the forensic evidence exists by construction
+        self._slow_trigger = SlowRequestTrigger(
+            threshold_s=forensics_slow_s, p99_factor=forensics_p99x,
+        )
 
     # -- task table (coordinator.go:370-388) -------------------------------
     def _task_set(self, key: TaskKey, rid: str, q: "queue.Queue") -> None:
@@ -432,13 +442,38 @@ class CoordRPCHandler:
                 tasks.append((w, shard))
                 if w.worker_byte != shard:
                     metrics.inc("coord.reassigned_shards")
+                    # reassignment marker on the request timeline: which
+                    # shard moved where (docs/FORENSICS.md)
+                    SPANS.event("coord.reassign", trace_id=trace.trace_id,
+                                node=self.tracer.identity, round=rid,
+                                shard=shard, to_byte=w.worker_byte)
             else:
                 pending.append(shard)
         return tasks, pending
 
     # -- RPCs ---------------------------------------------------------------
     def Mine(self, params) -> dict:
+        """Span-wrapped Mine (docs/FORENSICS.md): the whole RPC is one
+        ``coord.mine`` span — path (hit/miss/coalesced-hit) and error
+        outcomes included — keyed by the trace id the client's token
+        already carries, so the forensics plane stitches this node's
+        view into the request timeline with no new protocol state."""
         t0 = time.monotonic()
+        ts0 = time.time()
+        info: dict = {"path": "miss"}
+        try:
+            return self._mine_rpc(params, t0, info)
+        except BaseException as exc:
+            info.setdefault("outcome", f"error:{type(exc).__name__}")
+            raise
+        finally:
+            tid = info.pop("trace_id", 0)
+            if tid:
+                SPANS.record("coord.mine", ts0, time.monotonic() - t0,
+                             trace_id=tid, node=self.tracer.identity,
+                             **info)
+
+    def _mine_rpc(self, params, t0: float, info: dict) -> dict:
         metrics.inc("coord.mine_rpcs")
         nonce = bytes(params["nonce"])
         ntz = int(params["num_trailing_zeros"])
@@ -453,10 +488,15 @@ class CoordRPCHandler:
         trace.record_action(
             act.CoordinatorMine(nonce=nonce, num_trailing_zeros=ntz)
         )
+        tid = trace.trace_id
+        info["trace_id"] = tid
+        info["ntz"] = ntz
 
         cached = None if model else self.result_cache.get(nonce, ntz, trace)
         if cached is not None:
-            metrics.observe("coord.mine_s.hit", time.monotonic() - t0)
+            info["path"] = "hit"
+            metrics.observe("coord.mine_s.hit", time.monotonic() - t0,
+                            trace_id=tid)
             return self._success_reply(trace, nonce, ntz, cached)
 
         key = (nonce, ntz)
@@ -480,8 +520,10 @@ class CoordRPCHandler:
                 if cached is not None:
                     # same split rule as the key-lock era: a duplicate
                     # that waited out the leader's round is a hit
+                    info["path"] = "hit"
+                    info["coalesced"] = True
                     metrics.observe("coord.mine_s.hit",
-                                    time.monotonic() - t0)
+                                    time.monotonic() - t0, trace_id=tid)
                     return self._success_reply(trace, nonce, ntz, cached)
                 err = handle.error()
                 if err is not None:
@@ -503,8 +545,10 @@ class CoordRPCHandler:
                     cached = None if model else self.result_cache.get(
                         nonce, ntz, trace)
                     if cached is not None:
+                        info["path"] = "hit"
                         metrics.observe("coord.mine_s.hit",
-                                        time.monotonic() - t0)
+                                        time.monotonic() - t0,
+                                        trace_id=tid)
                         return self._success_reply(trace, nonce, ntz, cached)
                     reserved = self._admit(nonce, ntz)
                     try:
@@ -517,8 +561,10 @@ class CoordRPCHandler:
                         # discipline): an all-workers-died RuntimeError
                         # after minutes of reassign probing is exactly
                         # the outage latency this split exists to show
-                        metrics.observe("coord.mine_s.miss",
-                                        time.monotonic() - t0)
+                        miss_s = time.monotonic() - t0
+                        metrics.observe("coord.mine_s.miss", miss_s,
+                                        trace_id=tid)
+                        self._maybe_capture_slow(tid, nonce, ntz, miss_s)
             except BaseException as exc:
                 err2 = exc
                 raise
@@ -530,6 +576,27 @@ class CoordRPCHandler:
         raise RuntimeError(
             f"mine for {nonce.hex()}/{ntz} made no progress after "
             f"repeated coalesced rounds"
+        )
+
+    def _maybe_capture_slow(self, tid: int, nonce: bytes, ntz: int,
+                            dur_s: float) -> None:
+        """Slow-request auto-capture (docs/FORENSICS.md): when the
+        trigger fires, the request's span tree — everything this node's
+        ring retains for the trace — is snapshotted into the flight
+        recorder, so the evidence for the tail outlier is captured by
+        construction (the PR 3 dump-on-fault discipline), not by
+        whoever notices the p99 move."""
+        if not self._slow_trigger.armed:
+            return
+        reason = self._slow_trigger.observe(dur_s)
+        if reason is None:
+            return
+        metrics.inc("forensics.slow_captures")
+        RECORDER.record(
+            "forensics.slow_request", trace_id=tid, nonce=nonce.hex(),
+            ntz=ntz, dur_s=round(dur_s, 6), reason=reason,
+            threshold_s=self._slow_trigger.threshold_s,
+            spans=SPANS.spans_for(tid),
         )
 
     def _admit(self, nonce: bytes, ntz: int) -> bool:
@@ -823,12 +890,21 @@ class CoordRPCHandler:
         # distributions: fanout->first-result (the race the paper's
         # contract is about) and fanout->last-ack (cancel propagation)
         fanout_t0 = time.monotonic()
+        fanout_ts = time.time()
         RECORDER.record("coord.fanout", round=rid, nonce=nonce.hex(),
                         ntz=ntz)
         if plan is None:
             plan = self.fleet.round_plan()
         tasks, pending, inflight = self._assign_shards(trace, nonce, ntz, rid,
                                                        model, plan)
+        # forensics span (docs/FORENSICS.md): the shard-issue phase,
+        # carved out of timestamps the round takes anyway — spans are
+        # derived observers, never new trace actions
+        SPANS.record("coord.fanout", fanout_ts,
+                     time.monotonic() - fanout_t0,
+                     trace_id=trace.trace_id, node=self.tracer.identity,
+                     round=rid, nonce=nonce.hex(), ntz=ntz,
+                     shards=len(tasks))
 
         # first-result-wins (coordinator.go:202-206); under "reassign",
         # waiting is interleaved with liveness probes, the harvest of
@@ -855,11 +931,16 @@ class CoordRPCHandler:
                 tasks = self._maybe_hedge(trace, nonce, ntz, tasks, rid,
                                           model, plan, hedged)
         first_result_s = time.monotonic() - fanout_t0
-        metrics.observe("coord.first_result_s", first_result_s)
+        metrics.observe("coord.first_result_s", first_result_s,
+                        trace_id=trace.trace_id)
         RECORDER.record("coord.first_result", round=rid,
                         nonce=nonce.hex(), ntz=ntz,
                         worker_byte=int(first["worker_byte"]),
                         latency_s=round(first_result_s, 6))
+        SPANS.record("coord.first_result", fanout_ts, first_result_s,
+                     trace_id=trace.trace_id, node=self.tracer.identity,
+                     round=rid, nonce=nonce.hex(), ntz=ntz,
+                     winner_byte=int(first["worker_byte"]))
         if first["secret"] is None:
             raise RuntimeError(
                 "protocol violation: first worker message was a cancellation "
@@ -899,11 +980,20 @@ class CoordRPCHandler:
         # acknowledged the cancellation — fanout->last-ack is the
         # cancel-propagation latency the ISSUE-3 plane measures
         cancel_s = time.monotonic() - fanout_t0
-        metrics.observe("coord.cancel_propagation_s", cancel_s)
+        metrics.observe("coord.cancel_propagation_s", cancel_s,
+                        trace_id=trace.trace_id)
         RECORDER.record("coord.cancel_complete", round=rid,
                         nonce=nonce.hex(), ntz=ntz,
                         late_results=len(late),
                         latency_s=round(cancel_s, 6))
+        # the cancel-storm span starts where first_result ended, so the
+        # two tile the round on the stitched timeline instead of
+        # double-counting the race
+        SPANS.record("coord.cancel_storm", fanout_ts + first_result_s,
+                     cancel_s - first_result_s,
+                     trace_id=trace.trace_id, node=self.tracer.identity,
+                     round=rid, nonce=nonce.hex(), ntz=ntz,
+                     late_results=len(late))
 
         # late-result cache propagation (coordinator.go:250-280): each
         # rebroadcast is acked once per task (cache-update-only round)
@@ -995,6 +1085,10 @@ class CoordRPCHandler:
                 owner_byte=w.worker_byte, target_byte=target.worker_byte,
                 threshold_s=round(threshold, 3),
             )
+            SPANS.event("fleet.hedge", trace_id=trace.trace_id,
+                        node=self.tracer.identity, round=rid, shard=shard,
+                        owner_byte=w.worker_byte,
+                        target_byte=target.worker_byte)
             log.info("hedged shard %d of silent worker %d onto worker %d",
                      shard, w.worker_byte, target.worker_byte)
         return tasks
@@ -1264,6 +1358,8 @@ class Coordinator:
             lease_ttl_s=getattr(config, "FleetLeaseTTLS", 10.0) or 10.0,
             hedge=bool(getattr(config, "FleetHedge", True)),
             hedge_multiple=getattr(config, "FleetHedgeMultiple", 3.0) or 3.0,
+            forensics_slow_s=getattr(config, "ForensicsSlowS", 0.0) or 0.0,
+            forensics_p99x=getattr(config, "ForensicsSlowP99X", 0.0) or 0.0,
         )
         self.server = RPCServer()
         self.server.register("CoordRPCHandler", self.handler)

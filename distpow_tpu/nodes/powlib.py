@@ -80,6 +80,7 @@ from typing import Optional
 from ..runtime import actions as act
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.rpc import RPCClient, RPCError, RPCRetryAfter, RPCTransportError
+from ..runtime.spans import SPANS
 from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, wire_token
 
@@ -365,6 +366,7 @@ class POW:
     def _call_mine(self, tracer, nonce, num_trailing_zeros, trace,
                    hash_model=None) -> None:
         t0 = time.monotonic()
+        ts0 = time.time()
         try:
             trace.record_action(
                 act.PowlibMine(nonce=nonce, num_trailing_zeros=num_trailing_zeros)
@@ -374,6 +376,13 @@ class POW:
                                                num_trailing_zeros, hash_model)
             except _MineFailed as exc:
                 log.error("mine RPC failed: %s", exc)
+                # the client half of the request timeline records its
+                # failures too — a degraded mine is forensics evidence,
+                # not just a log line (docs/FORENSICS.md)
+                SPANS.record("powlib.mine", ts0, time.monotonic() - t0,
+                             trace_id=trace.trace_id,
+                             node=tracer.identity, ntz=num_trailing_zeros,
+                             outcome="error")
                 if not self._close_ev.is_set():
                     # deliver the failure: a silent drop would leave
                     # the client blocked on the notify queue forever
@@ -387,8 +396,15 @@ class POW:
             if result is None:  # closed mid-call
                 return
             # client-observed mine round-trip, retries and backoff
-            # included — the end-to-end latency a caller actually waits
-            metrics.observe("powlib.mine_s", time.monotonic() - t0)
+            # included — the end-to-end latency a caller actually waits.
+            # The trace id rides as the histogram's bucket exemplar and
+            # keys the client-side span of the request timeline.
+            mine_s = time.monotonic() - t0
+            metrics.observe("powlib.mine_s", mine_s,
+                            trace_id=trace.trace_id)
+            SPANS.record("powlib.mine", ts0, mine_s,
+                         trace_id=trace.trace_id, node=tracer.identity,
+                         ntz=num_trailing_zeros, outcome="ok")
             token = decode_token(result["token"])
             result_trace = tracer.receive_token(token)
             mr = MineResult(

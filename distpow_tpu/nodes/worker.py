@@ -33,6 +33,7 @@ Results leave through a queue drained by a forwarder thread issuing async
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import queue
@@ -47,6 +48,7 @@ from ..runtime.cache import ResultCache
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import WorkerConfig
 from ..runtime.rpc import RPCClient, RPCServer, StatsOnly
+from ..runtime.spans import SPANS
 from ..runtime.telemetry import RECORDER
 from ..runtime.tracing import Tracer, decode_token, make_tracer, wire_token
 from ..runtime.watchdog import WATCHDOG
@@ -407,6 +409,17 @@ class WorkerRPCHandler:
 
     def _mine(self, key: TaskKey, worker_bits: int, round_: TaskRound,
               trace, hash_model=None, tb_range=None) -> None:
+        # forensics binding (runtime/spans.py, docs/FORENSICS.md): the
+        # miner thread carries its request's trace id so the layers
+        # below the RPC surface — the search drivers' launch/poll spans
+        # and the scheduler's slot spans — attribute to this Mine
+        # without threading ids through every call
+        with SPANS.bind(trace.trace_id, self.tracer.identity):
+            self._mine_bound(key, worker_bits, round_, trace, hash_model,
+                             tb_range)
+
+    def _mine_bound(self, key: TaskKey, worker_bits: int, round_: TaskRound,
+                    trace, hash_model=None, tb_range=None) -> None:
         nonce, ntz, worker_byte = key
         t0 = time.monotonic()
         # mixed-hash requests bypass the (single-model) dominance cache
@@ -440,25 +453,35 @@ class WorkerRPCHandler:
             tbs = list(range(tb_range[0], tb_range[0] + tb_range[1]))
         else:
             tbs = partition.thread_bytes(worker_byte, worker_bits)
-        if self.scheduler is not None:
-            # scheduler path: this thread only parks on the slot's
-            # completion — the engine's single loop owns the device, so
-            # the active_searches pile-up the contention stress test
-            # recorded cannot form (docs/SCHEDULER.md).  Mixed-hash
-            # requests ride the same slot table: the engine packs
-            # per-model sub-batches into one launch (docs/SERVING.md)
-            secret = self.scheduler.search(
-                nonce, ntz, tbs, cancel_check=cancel_check,
-                hash_model=hash_model,
-            )
-        else:
-            self._searches_delta(+1)
-            try:
-                secret = self.backend.search(
-                    nonce, ntz, tbs, cancel_check=cancel_check
+        # one "worker.solve" span per REAL device search (cache replays
+        # returned above): the per-shard segment forensics attributes a
+        # slow round to (docs/FORENSICS.md) — the context-manager form
+        # records error outcomes too, so a dead miner thread still
+        # leaves its span
+        with SPANS.span("worker.solve", shard=worker_byte,
+                        model=hash_model or self._default_model()) as sp:
+            if self.scheduler is not None:
+                # scheduler path: this thread only parks on the slot's
+                # completion — the engine's single loop owns the device,
+                # so the active_searches pile-up the contention stress
+                # test recorded cannot form (docs/SCHEDULER.md).
+                # Mixed-hash requests ride the same slot table: the
+                # engine packs per-model sub-batches into one launch
+                # (docs/SERVING.md)
+                secret = self.scheduler.search(
+                    nonce, ntz, tbs, cancel_check=cancel_check,
+                    hash_model=hash_model,
                 )
-            finally:
-                self._searches_delta(-1)
+            else:
+                self._searches_delta(+1)
+                try:
+                    secret = self.backend.search(
+                        nonce, ntz, tbs, cancel_check=cancel_check
+                    )
+                finally:
+                    self._searches_delta(-1)
+            sp.annotate(outcome="found" if secret is not None
+                        else "no-result")
         if round_.superseded:
             # a newer Mine owns this key now; anything we emit would be
             # mis-attributed to its round (see TaskRound) — exit silently
@@ -471,10 +494,11 @@ class WorkerRPCHandler:
             # (distpow_tpu/obs/, docs/SLO.md) — per-hash performance
             # spread is why serving targets cannot be global.
             solve_s = time.monotonic() - t0
-            metrics.observe("worker.solve_s", solve_s)
+            metrics.observe("worker.solve_s", solve_s,
+                            trace_id=trace.trace_id)
             metrics.observe(
                 f"worker.solve_s.{hash_model or self._default_model()}",
-                solve_s,
+                solve_s, trace_id=trace.trace_id,
             )
             self._finish_found(key, secret, round_, trace,
                                hash_model=hash_model if off_model else None)
@@ -706,6 +730,16 @@ class Worker:
         that cache (VERDICT r1 weak #5).
         """
 
+        def _result_trace_id(res) -> int:
+            """Trace id straight out of the message's (self-contained
+            JSON) tracing token, WITHOUT a tracer side effect — the
+            forwarder must not tick vector clocks."""
+            try:
+                return int(json.loads(
+                    bytes(res.get("token") or b"").decode())["trace_id"])
+            except (ValueError, KeyError, TypeError):
+                return 0
+
         def forward():
             backoff = 0.2
             while True:
@@ -714,11 +748,36 @@ class Worker:
                               self.result_queue.qsize())
                 if res is None:
                     return
+                tid = _result_trace_id(res) if SPANS.enabled else 0
+                # the delivery clock starts ONCE per message, outside
+                # the retry loop: a delivery that burned attempts and
+                # backoff against an unreachable coordinator must show
+                # its full stall on the timeline, not just the final
+                # (fast) successful attempt (review PR 9)
+                fwd_ts = time.time()
+                fwd_t0 = time.monotonic()
+                attempts = 0
                 while not self._stopping.is_set():
                     try:
+                        attempts += 1
                         self.coordinator.go(
                             "CoordRPCHandler.Result", res
                         ).result(timeout=10.0)
+                        if tid:
+                            # the delivery leg of the request timeline:
+                            # a delayed/retried Result shows up HERE,
+                            # not in worker.solve — exactly the segment
+                            # that otherwise hides between two nodes'
+                            # clocks (docs/FORENSICS.md)
+                            SPANS.record(
+                                "worker.result_forward", fwd_ts,
+                                time.monotonic() - fwd_t0, trace_id=tid,
+                                node=self.config.WorkerID,
+                                worker_byte=int(res["worker_byte"]),
+                                attempts=attempts,
+                                kind=("result" if res.get("secret")
+                                      is not None else "ack"),
+                            )
                         backoff = 0.2
                         break
                     except Exception as exc:

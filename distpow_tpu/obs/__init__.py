@@ -15,7 +15,11 @@ top of it:
   config file (config/slo.json) evaluated over merged snapshots with
   fast/slow burn-rate windows, producing a typed verdict, a nonzero
   exit code for CI, and a flight-recorder breach event + critical-path
-  dump on breach.
+  dump (with the top-k slowest request timelines) on breach;
+* :mod:`.forensics` — per-request cross-node forensics (ISSUE 14,
+  docs/FORENSICS.md): concurrent ``Node.Spans`` sweeps over the fleet
+  and timeline stitching that names the shard/segment a slow Mine
+  spent its time in.
 
 Consumers: ``python -m distpow_tpu.cli.stats --cluster``, ``python -m
 distpow_tpu.cli.slo``, the open-loop load harness
@@ -23,6 +27,7 @@ distpow_tpu.cli.slo``, the open-loop load harness
 ``scripts/ci.sh --slo-smoke``.
 """
 
+from .forensics import fetch_spans, render_timeline, stitch_timeline
 from .merge import merge_histograms, merge_snapshots, merged_percentile
 from .scrape import FleetScraper, NodeTarget, scrape_cluster
 from .slo import (
@@ -34,6 +39,9 @@ from .slo import (
 )
 
 __all__ = [
+    "fetch_spans",
+    "stitch_timeline",
+    "render_timeline",
     "merge_histograms",
     "merge_snapshots",
     "merged_percentile",

@@ -95,8 +95,13 @@ def merge_histograms(hists: Iterable[dict]) -> dict:
 
     The inputs share one global bucket geometry, so buckets merge by
     exact upper-bound identity; count/sum add, min/max combine, and the
-    percentile estimates are recomputed over the merged buckets."""
+    percentile estimates are recomputed over the merged buckets.
+    Exemplars (docs/FORENSICS.md) merge bucket-wise too: each merged
+    bucket keeps the FRESHEST ``(trace_id, value, ts)`` any node
+    retained — "which request last landed here" is a cluster-wide
+    question with a single answer per bucket."""
     buckets: Dict[float, int] = {}
+    exemplars: Dict[float, list] = {}
     count = 0
     total = 0.0
     mn: Optional[float] = None
@@ -108,6 +113,10 @@ def merge_histograms(hists: Iterable[dict]) -> dict:
         total += float(h.get("sum", 0.0))
         for bound, n in h.get("buckets", []):
             buckets[float(bound)] = buckets.get(float(bound), 0) + int(n)
+        for bound, tid, v, ts in h.get("exemplars", []):
+            cur = exemplars.get(float(bound))
+            if cur is None or float(ts) > float(cur[3]):
+                exemplars[float(bound)] = [float(bound), tid, v, ts]
         for v, pick in ((h.get("min"), min), (h.get("max"), max)):
             if v is None:
                 continue
@@ -115,7 +124,10 @@ def merge_histograms(hists: Iterable[dict]) -> dict:
                 mn = v if mn is None else min(mn, v)
             else:
                 mx = v if mx is None else max(mx, v)
-    return _hist_stats(sorted(buckets.items()), count, total, mn, mx)
+    out = _hist_stats(sorted(buckets.items()), count, total, mn, mx)
+    if exemplars:
+        out["exemplars"] = [exemplars[b] for b in sorted(exemplars)]
+    return out
 
 
 def delta_histogram(new: Optional[dict], old: Optional[dict]) -> dict:
@@ -127,7 +139,9 @@ def delta_histogram(new: Optional[dict], old: Optional[dict]) -> dict:
     registry, and a negative bucket would poison the percentile walk);
     ``min``/``max`` are not recoverable from cumulative snapshots, so
     the delta keeps the NEW snapshot's extremes — percentile clamping
-    stays conservative."""
+    stays conservative.  Exemplars keep the NEW snapshot's view too:
+    "last request observed in this bucket" is already a point-in-time
+    fact, not a cumulative one."""
     if not new:
         return _hist_stats([], 0, 0.0, None, None)
     if not old:
@@ -140,8 +154,11 @@ def delta_histogram(new: Optional[dict], old: Optional[dict]) -> dict:
             buckets[float(bound)] = d
     count = max(0, int(new.get("count", 0)) - int(old.get("count", 0)))
     total = max(0.0, float(new.get("sum", 0.0)) - float(old.get("sum", 0.0)))
-    return _hist_stats(sorted(buckets.items()), count, total,
-                       new.get("min"), new.get("max"))
+    out = _hist_stats(sorted(buckets.items()), count, total,
+                      new.get("min"), new.get("max"))
+    if new.get("exemplars"):
+        out["exemplars"] = [list(e) for e in new["exemplars"]]
+    return out
 
 
 def merge_snapshots(node_snaps: Dict[str, dict],

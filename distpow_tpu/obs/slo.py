@@ -24,10 +24,11 @@ shell script grepping ``--prom`` output:
 
 On breach the engine records one ``slo.breach`` flight-recorder event
 per breached objective and dumps the whole ring — metrics snapshot,
-verdict, and (when a telemetry journal is configured) the
-``trace_profile`` critical-path breakdown of the slowest recent
-requests — so the evidence for *why* the objective burned is captured
-by construction (the PR 3 dump-on-fault discipline).
+verdict, (when a telemetry journal is configured) the ``trace_profile``
+critical-path breakdown of the slowest recent rounds, and the top-k
+slowest request timelines as full span trees (runtime/spans.py,
+docs/FORENSICS.md) — so the evidence for *why* the objective burned is
+captured by construction (the PR 3 dump-on-fault discipline).
 
 Per-model objectives (``"per_model": true``) expand over the
 ``worker.solve_s.<model>`` histogram family (nodes/worker.py), because
@@ -51,6 +52,7 @@ from ..runtime.metrics import (
     KNOWN_HISTOGRAMS,
 )
 from ..runtime.metrics import REGISTRY as metrics
+from ..runtime.spans import SPANS
 from ..runtime.telemetry import RECORDER
 from .merge import PER_MODEL_HISTOGRAM_PREFIX, delta_merged
 
@@ -276,10 +278,16 @@ class SLOEngine:
     for deterministic tests — production callers omit them."""
 
     def __init__(self, config: SLOConfig, history: int = 512,
-                 journal_path: Optional[str] = None):
+                 journal_path: Optional[str] = None,
+                 span_addrs: Optional[List[str]] = None):
         self.config = config
         self._history: "deque[Tuple[float, dict]]" = deque(maxlen=history)
         self._journal_path = journal_path
+        # where to fetch slow-request span trees from when THIS process
+        # has no local ring evidence (the cli/slo.py gate judging a
+        # separate-process cluster): the scraped fleet's addresses, for
+        # a Node.Spans sweep on breach (docs/FORENSICS.md)
+        self._span_addrs = list(span_addrs or [])
 
     # -- history ------------------------------------------------------------
     def observe(self, merged: dict, ts: Optional[float] = None) -> None:
@@ -431,6 +439,26 @@ class SLOEngine:
         profile = self._critical_path()
         if profile is not None:
             extra["critical_path"] = profile
+        # the forensics upgrade (ISSUE 14, docs/FORENSICS.md): the dump
+        # attaches the top-k slowest REQUEST timelines — full span
+        # trees, not just round milestones — so "which request burned
+        # the objective, and where inside it" is in the evidence file
+        # by construction.  In-process harnesses read the shared local
+        # ring; the production gate process (cli/slo.py observing a
+        # separate-process cluster) has an EMPTY local ring and sweeps
+        # the scraped fleet's Node.Spans instead — best-effort, like
+        # every other evidence hook (a breach verdict must never crash
+        # on its own evidence collection).
+        slow = SPANS.slowest_traces(5)
+        if not slow and self._span_addrs:
+            try:
+                from .forensics import slowest_request_timelines
+
+                slow = slowest_request_timelines(self._span_addrs, k=5)
+            except Exception:
+                slow = []
+        if slow:
+            extra["slow_requests"] = slow
         verdict.dump_path = RECORDER.dump("slo-breach", extra=extra)
 
     def _critical_path(self, top_n: int = 5) -> Optional[list]:

@@ -48,6 +48,7 @@ from ..ops.search_step import (
     cached_search_step,
 )
 from ..runtime.metrics import REGISTRY as metrics
+from ..runtime.spans import SPANS
 from ..runtime.watchdog import FIRST_COMPILE_GRACE_S, WATCHDOG
 
 DEFAULT_BATCH = 1 << 20
@@ -330,9 +331,18 @@ def search(
         # exactly what the persistent driver's polling drain avoids
         # (bench.py --serving-loop measures the two against each other)
         metrics.inc("search.blocking_syncs")
+        fetch_ts = time.time()
         fetch_t0 = time.monotonic()
         f = int(res)
-        metrics.observe("search.launch_s", time.monotonic() - fetch_t0)
+        fetch_s = time.monotonic() - fetch_t0
+        metrics.observe("search.launch_s", fetch_s)
+        if SPANS.enabled:
+            # per-dispatch forensics segment: the trace id rides the
+            # miner thread's binding (nodes/worker.py SPANS.bind), so a
+            # request's launches line up under it on the stitched
+            # timeline (docs/FORENSICS.md)
+            SPANS.record("search.launch", fetch_ts, fetch_s,
+                         n_cand=n_cand)
         _RATE_METER.note(n_cand)
         if f == SENTINEL:
             return None
@@ -573,6 +583,7 @@ def persistent_search(
         nonlocal hashes
         res, chunk0, vw, extra, seg_chunks, chunks_each, is_pair = \
             inflight.popleft()
+        poll_ts = time.time()
         poll_t0 = time.monotonic()
         waited = False
         # deliberately NO WATCHDOG.beat() inside the poll wait: a hung
@@ -591,7 +602,12 @@ def persistent_search(
                 return None, True
             time.sleep(poll_interval_s)
         if waited:
-            metrics.observe("search.poll_s", time.monotonic() - poll_t0)
+            poll_s = time.monotonic() - poll_t0
+            metrics.observe("search.poll_s", poll_s)
+            if SPANS.enabled:
+                # the persistent twin of the serial driver's
+                # search.launch span (same thread-bound trace id)
+                SPANS.record("search.poll", poll_ts, poll_s)
         if is_pair:
             f, segs = _fetch_pair(res)
             metrics.inc("search.persistent_steps", segs)

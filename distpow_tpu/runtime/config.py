@@ -137,6 +137,16 @@ class CoordinatorConfig:
     # worker's in-flight rounds to finish before releasing the lease
     # anyway.
     FleetDrainTimeoutS: float = 20.0
+    # --- request forensics (runtime/spans.py, docs/FORENSICS.md) ---------
+    # Slow-request auto-capture: a completed Mine miss slower than this
+    # fixed budget (seconds) captures its span tree into the flight
+    # recorder.  0 = arm the fixed-threshold trigger off.
+    ForensicsSlowS: float = 0.0
+    # Rolling-p99 exceedance arm: a miss slower than this multiple of
+    # the rolling p99 over recent misses is captured even when the
+    # fixed budget is generous.  0 = off.  Both arms off (the default)
+    # disables the trigger entirely.
+    ForensicsSlowP99X: float = 0.0
 
 
 @dataclass

@@ -86,6 +86,14 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
 * ``fleet.hedged_shards`` — straggler shards duplicated onto the
   least-loaded live worker while a round waited on a silent owner
   (nodes/coordinator.py ``_maybe_hedge``)
+* ``spans.dropped`` — span-ring overwrites: per-trace forensics
+  fetches lose their oldest spans (runtime/spans.py, docs/FORENSICS.md)
+* ``forensics.slow_captures`` — Mine rounds auto-captured into the
+  flight recorder by the slow-request trigger (threshold or rolling-p99
+  exceedance — nodes/coordinator.py, runtime/spans.py)
+* ``forensics.fetches`` / ``forensics.fetch_failures`` — fleet-wide
+  span sweeps issued and per-node Spans polls that failed or missed
+  the shared deadline (distpow_tpu/obs/forensics.py)
 
 Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 ``KNOWN_HISTOGRAM_PREFIXES`` vs ``observe()``/``time()`` call sites):
@@ -166,6 +174,9 @@ KNOWN_COUNTERS = frozenset({
     "slo.evaluations", "slo.breaches",
     "fleet.joins", "fleet.lease_expiries", "fleet.drains",
     "fleet.hedged_shards",
+    "spans.dropped",
+    "forensics.slow_captures",
+    "forensics.fetches", "forensics.fetch_failures",
 })
 
 # Families minted from runtime values (f-string call sites): the
@@ -211,13 +222,21 @@ class Histogram:
     ESTIMATES (each reported percentile is the upper bound of its
     bucket, so estimates err high by at most one bucket width, ~19%).
 
+    Exemplars (docs/FORENSICS.md): each bucket retains the LAST
+    ``(trace_id, value, ts)`` observed with a trace id — the pointer
+    from "p99 moved" to the one request that landed there, at a
+    bounded (one tuple per touched bucket) memory cost.  Merged
+    bucket-wise across nodes (obs/merge.py, freshest wins) and emitted
+    as OpenMetrics exemplars by ``stats --prom --openmetrics``.
+
     Lock discipline: instances carry no lock of their own — the owning
     :class:`Metrics` registry serializes ``observe`` under its single
     registry lock, the same (cheap) critical section a counter
     increment pays.
     """
 
-    __slots__ = ("count", "sum", "min", "max", "_buckets", "_zeros")
+    __slots__ = ("count", "sum", "min", "max", "_buckets", "_zeros",
+                 "_exemplars")
 
     def __init__(self) -> None:
         self.count = 0
@@ -226,8 +245,11 @@ class Histogram:
         self.max: Optional[float] = None
         self._buckets: Dict[int, int] = {}  # log-bucket index -> count
         self._zeros = 0  # non-positive samples (zero-latency clock ticks)
+        # log-bucket index (None = zero bucket) -> (trace_id, value, ts)
+        self._exemplars: Dict[Optional[int], Tuple[int, float, float]] = {}
 
-    def observe(self, value: Number) -> None:
+    def observe(self, value: Number,
+                trace_id: Optional[int] = None) -> None:
         v = float(value)
         self.count += 1
         self.sum += v
@@ -239,7 +261,11 @@ class Histogram:
             idx = math.floor(math.log(v) / _LOG_GROWTH)
             self._buckets[idx] = self._buckets.get(idx, 0) + 1
         else:
+            idx = None
             self._zeros += 1
+        if trace_id:
+            self._exemplars[idx] = (int(trace_id), v,
+                                    round(time.time(), 6))
 
     @staticmethod
     def bound(idx: int) -> float:
@@ -266,7 +292,10 @@ class Histogram:
     def to_dict(self) -> dict:
         """JSON-able snapshot; ``buckets`` is ``[[upper_bound, count],
         ...]`` in ascending bound order (non-cumulative — the Prometheus
-        renderer in cli/stats.py accumulates)."""
+        renderer in cli/stats.py accumulates).  ``exemplars`` rides only
+        when some bucket holds one: ``[[upper_bound, trace_id, value,
+        ts], ...]`` keyed by the same rounded bounds, so merge and
+        rendering pair them with their buckets exactly."""
         buckets: List[Tuple[float, int]] = []
         if self._zeros:
             buckets.append((0.0, self._zeros))
@@ -274,7 +303,7 @@ class Histogram:
             (round(self.bound(i), 9), self._buckets[i])
             for i in sorted(self._buckets)
         )
-        return {
+        out = {
             "count": self.count,
             "sum": round(self.sum, 9),
             "min": self.min,
@@ -284,6 +313,16 @@ class Histogram:
             "p99": self.percentile(0.99),
             "buckets": [[b, c] for b, c in buckets],
         }
+        if self._exemplars:
+            out["exemplars"] = [
+                [0.0 if i is None else round(self.bound(i), 9),
+                 tid, v, ts]
+                for i, (tid, v, ts) in sorted(
+                    self._exemplars.items(),
+                    key=lambda kv: (float("-inf") if kv[0] is None
+                                    else kv[0]))
+            ]
+        return out
 
 
 class _Timer:
@@ -312,6 +351,11 @@ class Metrics:
         self._hists: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
         self._start = time.time()
+        # exemplar capture switch (docs/FORENSICS.md): call sites pass
+        # trace ids unconditionally; flipping this off drops them at
+        # the registry so bench.py --forensics-overhead can measure
+        # exemplars-on vs -off without touching the instrumented seams
+        self.exemplars_enabled = True
 
     def inc(self, name: str, n: Number = 1) -> None:
         with self._lock:
@@ -321,14 +365,18 @@ class Metrics:
         with self._lock:
             self._gauges[name] = value
 
-    def observe(self, name: str, value: Number) -> None:
+    def observe(self, name: str, value: Number,
+                trace_id: Optional[int] = None) -> None:
         """Add one sample to the named histogram (created on first
-        touch, like counters — distpow-lint polices the names)."""
+        touch, like counters — distpow-lint polices the names).
+        ``trace_id`` (when the call site has a request in scope)
+        retains the sample as its bucket's exemplar."""
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = Histogram()
-            h.observe(value)
+            h.observe(value,
+                      trace_id if self.exemplars_enabled else None)
 
     def time(self, name: str) -> _Timer:
         """``with metrics.time("x.y"): ...`` observes the block's

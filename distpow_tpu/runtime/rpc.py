@@ -223,18 +223,42 @@ def split_bind_addr(addr: str) -> Tuple[str, int]:
 
 
 class StatsOnly:
-    """Stats-only view of a node handler, for registration under the
-    role-agnostic ``Node`` service name (nodes/coordinator.py,
-    nodes/worker.py): observability callers resolve any node's Stats
-    without knowing — or mis-probing — its role, so auto-role discovery
+    """Observability-only view of a node handler, for registration
+    under the role-agnostic ``Node`` service name (nodes/coordinator.py,
+    nodes/worker.py): callers resolve any node's Stats — and, since the
+    forensics plane (docs/FORENSICS.md), its span ring via ``Spans`` —
+    without knowing or mis-probing its role, so auto-role discovery
     never mints ``rpc.handler_errors`` on the node being observed
-    (distpow_tpu/obs/scrape.py, docs/SLO.md)."""
+    (distpow_tpu/obs/scrape.py, docs/SLO.md).  The protocol surface
+    stays single-named; this view never exposes protocol methods."""
 
     def __init__(self, handler):
         self._handler = handler
 
     def Stats(self, params) -> dict:
         return self._handler.Stats(params)
+
+    def Spans(self, params) -> dict:
+        """Span-ring export (runtime/spans.py, docs/FORENSICS.md).
+
+        ``{"trace_id": N}`` returns every retained span of that trace;
+        without a trace_id the reply carries per-trace SUMMARIES of the
+        recent ring (how a forensics caller finds the trace worth
+        fetching in full).  ``limit`` bounds either list.  The ring is
+        process-global, so an in-process multi-node harness answers
+        with the union — each span's ``node`` field keeps attribution
+        honest (the stitcher dedups by (node, seq))."""
+        from .spans import SPANS
+
+        limit = int(params.get("limit") or 512)
+        tracer = getattr(self._handler, "tracer", None)
+        out = {"node": getattr(tracer, "identity", "")}
+        tid = params.get("trace_id")
+        if tid is None:
+            out["traces"] = SPANS.trace_summaries(limit=limit)
+        else:
+            out["spans"] = SPANS.spans_for(int(tid), limit=limit)
+        return out
 
 
 class RPCServer:

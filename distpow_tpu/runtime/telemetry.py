@@ -47,6 +47,14 @@ log = logging.getLogger("distpow.telemetry")
 
 DEFAULT_CAPACITY = 2048
 DEFAULT_JOURNAL_INTERVAL_S = 5.0
+# Journal rotation (ISSUE 14 satellite): the append-only JSONL journal
+# grows without bound under soak load — once the live file exceeds the
+# byte cap it is rotated to ``<path>.1`` (older segments shift to .2,
+# .3, ...) and segments beyond the keep count are deleted, so total
+# disk is bounded at ~(keep + 1) x max_bytes while recent history
+# stays greppable in order.
+DEFAULT_JOURNAL_MAX_BYTES = 8 * 1024 * 1024
+DEFAULT_JOURNAL_KEEP = 3
 
 
 class FlightRecorder:
@@ -60,6 +68,8 @@ class FlightRecorder:
         self._journaled_seq = 0  # highest seq already flushed to JSONL
         self._journal_path: Optional[str] = None
         self._journal_interval = DEFAULT_JOURNAL_INTERVAL_S
+        self._journal_max_bytes = DEFAULT_JOURNAL_MAX_BYTES
+        self._journal_keep = DEFAULT_JOURNAL_KEEP
         self._journal_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._dump_dir: Optional[str] = None
@@ -91,7 +101,9 @@ class FlightRecorder:
     # -- configuration ------------------------------------------------------
     def configure(self, journal_path: Optional[str] = None,
                   journal_interval_s: float = DEFAULT_JOURNAL_INTERVAL_S,
-                  dump_dir: Optional[str] = None) -> None:
+                  dump_dir: Optional[str] = None,
+                  journal_max_bytes: int = DEFAULT_JOURNAL_MAX_BYTES,
+                  journal_keep: int = DEFAULT_JOURNAL_KEEP) -> None:
         """Enable the periodic JSONL journal and/or the dump directory.
 
         The recorder — and therefore the journal — is PER PROCESS: in
@@ -127,6 +139,8 @@ class FlightRecorder:
                 else:
                     self._journal_path = journal_path
                     self._journal_interval = float(journal_interval_s)
+                    self._journal_max_bytes = int(journal_max_bytes)
+                    self._journal_keep = max(0, int(journal_keep))
         if journal_path and (self._journal_thread is None
                              or not self._journal_thread.is_alive()):
             self._stop.clear()
@@ -176,6 +190,35 @@ class FlightRecorder:
                             "(will retry next flush): %s", exc)
                 return
             self._journaled_seq = pending[-1]["seq"]
+            self._maybe_rotate_locked(path)
+
+    def _maybe_rotate_locked(self, path: str) -> None:
+        """Size-capped rotation (module constants): once the live
+        journal exceeds the byte cap, shift ``path.(i)`` -> ``path.(i+1)``
+        (dropping segments beyond the keep count) and the live file to
+        ``path.1``.  Runs under the ring lock right after a successful
+        append — renames are bounded local metadata operations, the
+        FileSink discipline — so a racing flush can neither double-rotate
+        nor append to a mid-rotation file.  Best-effort like the append:
+        a failed rename costs rotation, never events."""
+        if self._journal_max_bytes <= 0:
+            return
+        try:
+            if os.path.getsize(path) < self._journal_max_bytes:
+                return
+            oldest = f"{path}.{self._journal_keep}"
+            if self._journal_keep == 0:
+                os.remove(path)
+                return
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self._journal_keep - 1, 0, -1):
+                seg = f"{path}.{i}"
+                if os.path.exists(seg):
+                    os.replace(seg, f"{path}.{i + 1}")
+            os.replace(path, f"{path}.1")
+        except OSError as exc:
+            log.warning("flight-recorder journal rotation failed: %s", exc)
 
     # -- dump-on-fault ------------------------------------------------------
     def dump(self, reason: str, dump_dir: Optional[str] = None,

@@ -75,6 +75,11 @@ METHODS: Tuple[str, ...] = (
     "Fleet.Heartbeat",
     "Fleet.Drain",
     "Fleet.Members",
+    # appended for the request-forensics plane (runtime/spans.py,
+    # docs/FORENSICS.md): the role-agnostic observability surface;
+    # table stays append-only
+    "Node.Stats",
+    "Node.Spans",
 )
 _METHOD_IDS = {m: i for i, m in enumerate(METHODS)}
 
@@ -98,6 +103,17 @@ KEYS: Tuple[str, ...] = (
     "capability",
     "ttl_s",
     "heartbeat_s",
+    # appended for the request-forensics plane (Node.Spans request and
+    # reply vocabulary — runtime/spans.py); table stays append-only
+    "trace_id",
+    "spans",
+    "limit",
+    "name",
+    "node",
+    "ts",
+    "dur_s",
+    "attrs",
+    "seq",
 )
 _KEY_IDS = {k: i for i, k in enumerate(KEYS)}
 

@@ -59,6 +59,7 @@ from ..ops.search_step import (
 from ..parallel.partition import contiguous_bounds
 from ..parallel.search import assemble_secret, effective_batch, width_segments
 from ..runtime.metrics import REGISTRY as metrics
+from ..runtime.spans import SPANS
 from ..runtime.telemetry import RECORDER
 from ..runtime.watchdog import FIRST_COMPILE_GRACE_S, WATCHDOG
 
@@ -82,7 +83,7 @@ class Slot:
         "cancel_check", "masks", "done", "secret", "error", "vtime",
         "launches", "submitted_t", "first_launch_t", "exhausted",
         "_segments", "vw", "seg_hi", "extra", "spec", "chunk0",
-        "_cancelled", "model",
+        "_cancelled", "model", "span", "preemptions",
     )
 
     def __init__(self, seq: int, nonce: bytes, ntz: int, tb_lo: int,
@@ -113,6 +114,8 @@ class Slot:
         self.extra = b""
         self.spec = None
         self.chunk0 = 0
+        self.span = None  # sched.slot forensics span (docs/FORENSICS.md)
+        self.preemptions = 0
 
     def cancel(self) -> None:
         """Request cancellation; honored at the next launch boundary."""
@@ -239,6 +242,18 @@ class BatchingScheduler:
             self._seq += 1
             slot = Slot(self._seq, nonce, difficulty, tb_lo, tbc,
                         cancel_check, weight, masks, segments, model)
+            # slot-residency forensics span (docs/FORENSICS.md): the
+            # submitting miner thread carries the request's trace id
+            # (SPANS.bind in nodes/worker.py), so the slot's whole
+            # scheduler life — queue wait, launches, preemptions —
+            # lands on that request's timeline.
+            # distpow: ok unclosed-span -- slot spans cross the
+            # submit(miner)->device-loop thread boundary by design;
+            # _finish() is the single exit point for every slot (hit,
+            # cancel, exhaustion, loop death, close) and finishes the
+            # handle exactly once
+            slot.span = SPANS.begin("sched.slot", seq=slot.seq,
+                                    model=model.name)
             # virtual-clock floor: a joining slot starts at the
             # currently most-starved slot's vtime, not 0 — otherwise a
             # stream of fresh arrivals (each sorting first at vtime 0)
@@ -401,6 +416,7 @@ class BatchingScheduler:
                 self._active.remove(victim)
                 self._pending.append(victim)
                 self._active.append(self._pending.pop(0))
+                victim.preemptions += 1
                 metrics.inc("sched.slots_preempted")
                 RECORDER.record(
                     "sched.slot_preempt", slot=victim.seq,
@@ -538,4 +554,10 @@ class BatchingScheduler:
                 error: Optional[str] = None) -> None:
         slot.secret = secret
         slot.error = error
+        if slot.span is not None:
+            slot.span.finish(
+                launches=slot.launches, preemptions=slot.preemptions,
+                outcome=("found" if secret is not None
+                         else "error" if error else "no-result"),
+            )
         slot.done.set()

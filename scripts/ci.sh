@@ -41,7 +41,14 @@
 # ranges and solves, a frozen straggler's shard is hedged, `stats
 # --discover`'s membership pull tracks the fleet, and a drain releases
 # only after its in-flight rounds finish — ~20 s, CPU, no jax.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke]
+# `--forensics-smoke` runs the request-forensics smoke
+# (scripts/forensics_smoke.py, docs/FORENSICS.md): a REAL 3-process
+# cluster (coordinator + 2 workers, one delayed by the PR 1 fault
+# plane), one slow Mine, then the forensics CLI's cross-process
+# Node.Spans sweep must stitch a timeline naming the delayed worker's
+# shard; trace_check must still report 0 violations — ~15 s, CPU,
+# no jax.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -104,6 +111,13 @@ if [ "${1:-}" = "--fleet-smoke" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "--forensics-smoke" ]; then
+  echo "=== forensics smoke (3-process cluster + delayed worker + stitched timeline) ==="
+  JAX_PLATFORMS=cpu python scripts/forensics_smoke.py
+  echo "=== forensics smoke OK ==="
+  exit 0
+fi
+
 if [ "${1:-}" = "--bench-rehearsal" ]; then
   echo "=== bench rehearsal (CPU platform, temp provenance) ==="
   tmp="$(mktemp -d)"
@@ -142,7 +156,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke]" >&2
           exit 2 ;;
 esac
 

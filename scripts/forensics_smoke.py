@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Deterministic request-forensics smoke (docs/FORENSICS.md;
+ci.sh --forensics-smoke).
+
+The ISSUE 14 acceptance scenario, end to end, on a REAL multi-process
+cluster — separate OS processes over localhost RPC, not an in-process
+harness sharing one span ring:
+
+1. boot tracing server + coordinator + 2 python-backend workers as
+   subprocesses (the reference deployment shape, SURVEY §3.5), with
+   worker2 carrying a PR 1 fault plan that DELAYS its first
+   ``CoordRPCHandler.Result`` frame by 1.5 s — the "one worker made
+   this request slow" injection;
+2. mine once from this process (powlib), harvest the trace id from the
+   result token — the same id every node's spans carry;
+3. run ``python -m distpow_tpu.cli.forensics --trace ID --json``
+   against all three nodes (a real cross-process ``Node.Spans`` sweep)
+   and assert the stitched timeline (a) spans every node, (b) names
+   worker2's shard as the slow shard via a ~1.5 s shard-attributed
+   segment;
+4. feed the stitched timeline JSON to ``scripts/trace_profile.py``
+   (its span-ring input format) and assert the shared wall-clock
+   renderer reports the round;
+5. run ``python -m distpow_tpu.cli.trace_check`` over the tracing
+   server's ShiViz log: the golden trace invariants must report
+   0 violations — spans are DERIVED observers and must not perturb the
+   16-action wire vocabulary.
+
+Prints one JSON summary line on stdout (details to stderr); exits 0
+only when every gate held — the scripts/chaos_smoke.py shape CI lanes
+expect.  ~15 s, pure CPU, no jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distpow_tpu.nodes import Client  # noqa: E402
+from distpow_tpu.runtime.config import (  # noqa: E402
+    ClientConfig,
+    CoordinatorConfig,
+    read_json_config,
+)
+from distpow_tpu.runtime.rpc import RPCClient  # noqa: E402
+
+DELAY_S = 1.5
+NTZ = 1
+
+#: worker2's fault plan: delay its FIRST Result frame (its found secret
+#: or, if the race cancelled it first, its first ack) — client-side, so
+#: the sleep lands inside the forwarder delivery the
+#: ``worker.result_forward`` span measures.
+FAULT_PLAN = json.dumps({
+    "seed": 14,
+    "rules": [{"kind": "delay", "side": "client",
+               "method": "CoordRPCHandler.Result",
+               "delay_s": DELAY_S, "max": 1}],
+})
+
+
+def gate(name, ok, detail=""):
+    print(f"[forensics-smoke] {'PASS' if ok else 'FAIL'}: {name}"
+          f"{' — ' + detail if detail else ''}", file=sys.stderr)
+    if not ok:
+        sys.exit(1)
+
+
+def wait_rpc(addr: str, method: str, timeout_s: float = 20.0) -> None:
+    """Poll an RPC endpoint until it answers — readiness without
+    stdout-scraping (fixed sleeps race on loaded machines)."""
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            c = RPCClient(addr, timeout=1.0)
+            try:
+                c.call(method, {}, timeout=2.0)
+                return
+            finally:
+                c.close()
+        except Exception as exc:  # readiness probe: any failure retries
+            last = exc
+            time.sleep(0.1)
+    raise AssertionError(f"{addr} never answered {method}: {last}")
+
+
+def main() -> int:
+    procs = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+
+    def spawn(*argv):
+        p = subprocess.Popen(
+            [sys.executable, *argv], cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs.append(p)
+        return p
+
+    with tempfile.TemporaryDirectory() as td:
+        subprocess.run(
+            [sys.executable, "-m", "distpow_tpu.cli.config_gen",
+             "--config-dir", td, "--workers", "2", "--seed", "1414"],
+            cwd=REPO, env=env, check=True, capture_output=True,
+        )
+        # python-backend workers: the smoke is control-plane forensics,
+        # not kernel work
+        wcfg_path = os.path.join(td, "worker_config.json")
+        wcfg = json.loads(open(wcfg_path).read())
+        wcfg["Backend"] = "python"
+        open(wcfg_path, "w").write(json.dumps(wcfg))
+        coord_cfg = read_json_config(
+            os.path.join(td, "coordinator_config.json"), CoordinatorConfig)
+        ts_cfg = json.loads(open(
+            os.path.join(td, "tracing_server_config.json")).read())
+        ts_cfg["OutputFile"] = os.path.join(td, "trace_output.log")
+        ts_cfg["ShivizOutputFile"] = os.path.join(td, "shiviz_output.log")
+        open(os.path.join(td, "tracing_server_config.json"),
+             "w").write(json.dumps(ts_cfg))
+
+        try:
+            spawn("-m", "distpow_tpu.cli.tracing_server",
+                  "--config", os.path.join(td,
+                                           "tracing_server_config.json"))
+            time.sleep(0.5)
+            spawn("-m", "distpow_tpu.cli.coordinator",
+                  "--config", os.path.join(td, "coordinator_config.json"))
+            spawn("-m", "distpow_tpu.cli.worker",
+                  "--config", wcfg_path, "--id", "worker1",
+                  "--listen", coord_cfg.Workers[0])
+            # worker2 is the DELAYED one: the PR 1 fault plane holds its
+            # first Result frame for DELAY_S
+            spawn("-m", "distpow_tpu.cli.worker",
+                  "--config", wcfg_path, "--id", "worker2",
+                  "--listen", coord_cfg.Workers[1],
+                  "--faults", FAULT_PLAN)
+            for addr in coord_cfg.Workers:
+                wait_rpc(addr, "WorkerRPCHandler.Ping")
+            wait_rpc(coord_cfg.ClientAPIListenAddr, "Node.Stats")
+            gate("real 3-process cluster up", True,
+                 f"coordinator + workers at {coord_cfg.Workers}")
+
+            client = Client(ClientConfig(
+                ClientID="fsmoke",
+                CoordAddr=coord_cfg.ClientAPIListenAddr))
+            client.initialize()
+            try:
+                t0 = time.monotonic()
+                client.mine(b"\x14\x01", NTZ)
+                res = client.notify_queue.get(timeout=60)
+                round_s = time.monotonic() - t0
+                gate("slow request completed", res.error is None,
+                     f"{round_s:.2f}s round (delay {DELAY_S}s injected)")
+                gate("delay actually bit", round_s >= DELAY_S * 0.9,
+                     f"round took {round_s:.2f}s")
+                trace_id = json.loads(res.token.decode())["trace_id"]
+            finally:
+                client.close()
+
+            addrs = [coord_cfg.ClientAPIListenAddr] + list(coord_cfg.Workers)
+            out = subprocess.run(
+                [sys.executable, "-m", "distpow_tpu.cli.forensics",
+                 "--trace", str(trace_id), "--json"]
+                + [x for a in addrs for x in ("--addr", a)],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=60,
+            )
+            gate("forensics CLI exit 0", out.returncode == 0,
+                 out.stderr[-500:])
+            timeline = json.loads(out.stdout)
+            nodes = set(timeline.get("nodes") or [])
+            gate("timeline spans every node",
+                 {"coordinator", "worker1", "worker2"} <= nodes,
+                 f"nodes={sorted(nodes)}")
+            gate("stitched timeline non-empty",
+                 len(timeline.get("spans") or []) >= 6,
+                 f"{len(timeline.get('spans') or [])} spans")
+            seg = timeline.get("slowest_shard_segment") or {}
+            gate("slow shard named", timeline.get("slow_shard") == 1,
+                 f"slow_shard={timeline.get('slow_shard')} via "
+                 f"{seg.get('name')} on {seg.get('node')} "
+                 f"({seg.get('dur_s', 0):.2f}s)")
+            gate("slow segment shows the injected delay",
+                 seg.get("node") == "worker2"
+                 and seg.get("dur_s", 0.0) >= DELAY_S * 0.9,
+                 f"{seg.get('dur_s', 0):.2f}s on {seg.get('node')}")
+
+            tl_path = os.path.join(td, "timeline.json")
+            open(tl_path, "w").write(out.stdout)
+            prof = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "scripts", "trace_profile.py"),
+                 tl_path],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=60,
+            )
+            gate("trace_profile reads the span-ring format",
+                 prof.returncode == 0
+                 and "1 fan-out round(s)" in prof.stdout,
+                 prof.stdout.strip().splitlines()[0]
+                 if prof.stdout.strip() else prof.stderr[-200:])
+
+            # spans are derived observers: the tracing-plane invariants
+            # must hold exactly as before
+            time.sleep(1.0)  # let the tracing server flush its logs
+            chk = subprocess.run(
+                [sys.executable, "-m", "distpow_tpu.cli.trace_check",
+                 ts_cfg["OutputFile"], ts_cfg["ShivizOutputFile"]],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=60,
+            )
+            gate("trace_check: 0 violations", chk.returncode == 0,
+                 (chk.stdout + chk.stderr).strip().splitlines()[-1]
+                 if (chk.stdout + chk.stderr).strip() else "")
+
+            print(json.dumps({
+                "metric": "forensics smoke: stitched cross-node timeline "
+                          "names the delayed worker's shard",
+                "trace_id": trace_id,
+                "round_s": round(round_s, 3),
+                "slow_shard": timeline.get("slow_shard"),
+                "slow_segment": {
+                    "name": seg.get("name"), "node": seg.get("node"),
+                    "dur_s": seg.get("dur_s"),
+                },
+                "nodes": sorted(nodes),
+                "ok": True,
+            }))
+            return 0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

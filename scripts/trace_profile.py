@@ -19,6 +19,12 @@ Accepted inputs (auto-detected):
 * **Flight-recorder journal** (``*.telemetry.jsonl``,
   runtime/telemetry.py): JSONL events carrying wall-clock ``ts`` —
   per-round fanout / first-result / cancel-complete timings in seconds.
+* **Span-ring JSON** (docs/FORENSICS.md): the forensics CLI's
+  ``--json`` timeline, a ``Node.Spans`` reply, or any JSON object
+  carrying a ``"spans"`` list — the coordinator's fanout /
+  first-result / cancel-storm spans collapse into the SAME wall-clock
+  per-round rows the journal format renders, so offline and live
+  forensics share one per-request breakdown renderer.
 
 Trace logs carry no timestamps (parity with the reference's tracing),
 so for the first two formats stage positions are **logical ticks**: the
@@ -157,6 +163,45 @@ def profile_requests(events: Dict[str, List[list]]) -> List[dict]:
     return requests
 
 
+def profile_spans(payload: dict) -> List[dict]:
+    """Span-ring JSON -> the journal-shaped per-round rows.
+
+    Reads the coordinator's round spans (``coord.fanout`` /
+    ``coord.first_result`` / ``coord.cancel_storm`` —
+    nodes/coordinator.py), keyed by their ``round`` attr (falling back
+    to the trace id for partial rings), and emits exactly the row
+    shape ``profile_journal`` does so both formats share the renderer.
+    ``cancel_propagation_s`` is re-assembled as first-result + storm:
+    the two spans tile the round on the timeline (the storm span
+    starts where the race ended)."""
+    rounds: Dict[str, dict] = {}
+    order: List[str] = []
+    for s in payload.get("spans") or []:
+        name = s.get("name", "")
+        if name not in ("coord.fanout", "coord.first_result",
+                        "coord.cancel_storm"):
+            continue
+        attrs = s.get("attrs") or {}
+        rid = attrs.get("round") or f"trace-{s.get('trace_id')}"
+        r = rounds.get(rid)
+        if r is None:
+            r = rounds[rid] = {"round": rid, "nonce": attrs.get("nonce"),
+                               "ntz": attrs.get("ntz"),
+                               "trace_id": s.get("trace_id")}
+            order.append(rid)
+        if name == "coord.fanout":
+            r["fanout_ts"] = s.get("ts")
+        elif name == "coord.first_result":
+            r["first_result_s"] = s.get("dur_s")
+            r["winner_byte"] = attrs.get("winner_byte")
+        elif name == "coord.cancel_storm":
+            r["cancel_propagation_s"] = round(
+                float(r.get("first_result_s") or 0.0)
+                + float(s.get("dur_s") or 0.0), 6)
+            r["late_results"] = attrs.get("late_results")
+    return [rounds[rid] for rid in order]
+
+
 def profile_journal(path: str) -> List[dict]:
     """Flight-recorder JSONL -> per-round wall-clock stage timings."""
     rounds: Dict[str, dict] = {}
@@ -221,11 +266,12 @@ def main(argv=None) -> int:
     if not os.path.exists(args.trace):
         print(f"trace_profile: no such file: {args.trace}", file=sys.stderr)
         return 2
-    if args.trace.endswith(".jsonl"):
-        rounds = profile_journal(args.trace)
+
+    def emit_wallclock(rounds: List[dict], fmt: str) -> int:
+        """ONE renderer for every wall-clock source (journal or span
+        ring) — the whole point of the shared row shape."""
         if args.as_json:
-            print(json.dumps({"format": "journal", "rounds": rounds},
-                             indent=2))
+            print(json.dumps({"format": fmt, "rounds": rounds}, indent=2))
             return 0
         print(f"# {len(rounds)} fan-out round(s) from {args.trace} "
               f"(wall-clock seconds)")
@@ -236,6 +282,23 @@ def main(argv=None) -> int:
                   f"cancel_propagation={r.get('cancel_propagation_s', '-')}s "
                   f"late_results={r.get('late_results', 0)}")
         return 0
+
+    if args.trace.endswith(".jsonl"):
+        return emit_wallclock(profile_journal(args.trace), "journal")
+    try:
+        # sniff only the head: a large human trace log must not be read
+        # (twice) just to learn it isn't JSON
+        with open(args.trace) as fh:
+            head = fh.read(64)
+            if head.lstrip().startswith("{"):
+                data = json.loads(head + fh.read())
+                if isinstance(data, dict) and "spans" in data:
+                    # span-ring JSON (docs/FORENSICS.md): the third
+                    # input format — same wall-clock renderer as the
+                    # journal
+                    return emit_wallclock(profile_spans(data), "spans")
+    except ValueError:
+        pass  # `{`-headed but not span JSON: golden/human paths below
 
     try:
         events = load_events(args.trace)

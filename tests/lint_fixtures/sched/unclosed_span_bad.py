@@ -1,0 +1,27 @@
+"""unclosed-span fixtures: raw begin sites the rule must flag."""
+
+from distpow_tpu.runtime.spans import SPANS
+
+
+def leaks_on_early_return(items):
+    sp = SPANS.begin("sched.slot", seq=1)  # finding: raw begin
+    if not items:
+        return None  # sp never finishes on this path
+    sp.finish()
+    return items
+
+
+def leaks_on_exception(nonce):
+    handle = SPANS.begin("worker.solve", shard=0)  # finding: raw begin
+    value = int(nonce)  # a raise here loses the span
+    handle.finish(outcome="found")
+    return value
+
+
+class Loop:
+    def __init__(self, spans):
+        self.spans = spans
+
+    def open_one(self):
+        # finding: begin through a lowercase alias receiver
+        return self.spans.begin("sched.slot", seq=2)

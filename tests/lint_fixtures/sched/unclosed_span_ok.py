@@ -1,0 +1,27 @@
+"""unclosed-span fixtures: the sanctioned shapes the rule must pass."""
+
+import time
+
+from distpow_tpu.runtime.spans import SPANS
+
+
+def context_managed(nonce):
+    # the blessed form: cannot leak, error exits record an outcome
+    with SPANS.span("worker.solve", shard=0) as sp:
+        value = int(nonce)
+        sp.annotate(outcome="found")
+    return value
+
+
+def one_shot_record():
+    # explicit-timing recorders have no open state to leak
+    t0 = time.time()
+    SPANS.record("search.launch", t0, 0.01, n_cand=256)
+    SPANS.event("coord.reassign", shard=3)
+
+
+def cross_thread_handle():
+    # distpow: ok unclosed-span -- the handle crosses to the device
+    # loop, whose _finish() is the single exit point for every slot
+    # outcome and finishes it exactly once
+    return SPANS.begin("sched.slot", seq=3)

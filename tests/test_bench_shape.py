@@ -507,3 +507,65 @@ def test_finalize_control_plane_headline_attaches_membership(bench):
     assert prov is None
     assert line["unit"] == "ms"
     assert line["membership"] == MB
+
+
+# -- forensics-overhead stage (ISSUE 14) --------------------------------------
+
+FO = {
+    "rounds_per_arm": 30, "ntz": 1,
+    "on": {"median_round_s": 0.0042, "solves_per_s": 238.6},
+    "off": {"median_round_s": 0.0041, "solves_per_s": 244.1},
+    "on_vs_off_x": 0.9774, "overhead_pct": 2.32,
+    "spans_recorded_on_arm": 436, "exemplars_present": True,
+    "within_5pct": True,
+}
+
+
+def test_finalize_attaches_forensics_row(bench):
+    """The forensics stage rides both artifacts of a normal run, like
+    the other tunnel-independent rows."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_FULL, 5.35e6, forensics=FO
+    )
+    assert line["forensics"] == FO
+    assert prov["forensics"] == FO
+    assert line["unit"] == "MH/s"
+
+
+def test_finalize_forensics_only_run(bench):
+    """bench.py --forensics-overhead: the headline is the on-vs-off
+    throughput ratio and kernel provenance is NOT re-stamped."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None, forensics=FO)
+    assert prov is None
+    assert line["unit"] == "x"
+    assert line["value"] == 0.9774
+    assert "spans+exemplars" in line["metric"]
+    assert line["forensics"] == FO
+
+
+def test_finalize_carries_forward_forensics(bench):
+    lm = dict(LAST_FULL, forensics=FO)
+    line, prov = bench.finalize_record({"serving": 9800.0e6}, lm, 5.35e6)
+    assert prov["forensics"] == FO
+    assert "forensics" not in line
+
+
+def test_finalize_control_plane_headline_attaches_forensics(bench):
+    """Device-unreachable runs that measured both CPU stages: the
+    control-plane row stays the headline, forensics rides along."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, control_plane=CP, forensics=FO
+    )
+    assert prov is None
+    assert line["unit"] == "ms"
+    assert line["forensics"] == FO
+
+
+def test_finalize_membership_only_attaches_forensics(bench):
+    """A membership-headline run still carries the forensics dict."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, membership=MB, forensics=FO
+    )
+    assert prov is None
+    assert line["unit"] == "s"
+    assert line["forensics"] == FO

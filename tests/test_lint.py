@@ -121,6 +121,12 @@ CASES = [
     # rule fires there and blesses the shared-deadline thread shape
     ("serial-rpc-fanout", os.path.join("obs", "serial_rpc_fanout_bad.py"),
      os.path.join("obs", "serial_rpc_fanout_ok.py"), 3),
+    # request forensics (ISSUE 14): a raw SPANS.begin leaks its span on
+    # any missed exit path — a silent hole in the request timeline; the
+    # ok fixture blesses the context-manager form, the one-shot
+    # recorders, and the justified cross-thread suppression
+    ("unclosed-span", os.path.join("sched", "unclosed_span_bad.py"),
+     os.path.join("sched", "unclosed_span_ok.py"), 3),
 ]
 
 

@@ -1,0 +1,676 @@
+"""Request-forensics plane tests (ISSUE 14, docs/FORENSICS.md).
+
+Covers the span recorder and slow-request trigger units, histogram
+exemplars (capture, snapshot, cluster-merge survival, OpenMetrics
+rendering), flight-recorder journal rotation, the ``Node.Spans`` RPC +
+cross-node stitch, span-tree completeness on the hard paths (coalesced
+waiters, mid-round reassignment, hedged duplicate shards, scheduler
+slots), the coordinator's slow-request auto-capture, SLO breach dumps
+attaching slow-request timelines, and ``trace_profile``'s span-ring
+input format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_nodes import Stack, mine_and_wait  # noqa: E402
+
+from distpow_tpu.models import puzzle  # noqa: E402
+from distpow_tpu.obs.forensics import (  # noqa: E402
+    fetch_spans,
+    render_timeline,
+    slowest_trace_id,
+    stitch_timeline,
+)
+from distpow_tpu.obs.merge import (  # noqa: E402
+    delta_histogram,
+    merge_histograms,
+)
+from distpow_tpu.runtime.metrics import REGISTRY as metrics  # noqa: E402
+from distpow_tpu.runtime.metrics import Histogram  # noqa: E402
+from distpow_tpu.runtime.spans import (  # noqa: E402
+    SPANS,
+    SlowRequestTrigger,
+    SpanRecorder,
+)
+from distpow_tpu.runtime.telemetry import RECORDER  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trace_id(res) -> int:
+    """Trace id from a MineResult's self-contained token."""
+    return int(json.loads(bytes(res.token).decode())["trace_id"])
+
+
+def _names(spans):
+    return {s["name"] for s in spans}
+
+
+# -- recorder unit ------------------------------------------------------------
+
+def test_span_context_manager_records_once():
+    rec = SpanRecorder(capacity=16)
+    with rec.span("worker.solve", trace_id=7, node="w", shard=3) as sp:
+        sp.annotate(outcome="found")
+    spans = rec.spans_for(7)
+    assert len(spans) == 1
+    s = spans[0]
+    assert s["name"] == "worker.solve" and s["node"] == "w"
+    assert s["attrs"] == {"shard": 3, "outcome": "found"}
+    assert s["dur_s"] >= 0.0
+
+
+def test_span_error_exit_tags_outcome():
+    rec = SpanRecorder(capacity=16)
+    with pytest.raises(ValueError):
+        with rec.span("worker.solve", trace_id=9, node="w"):
+            raise ValueError("boom")
+    (s,) = rec.spans_for(9)
+    assert s["attrs"]["outcome"] == "error:ValueError"
+
+
+def test_begin_finish_is_idempotent():
+    rec = SpanRecorder(capacity=16)
+    h = rec.begin("sched.slot", trace_id=5, node="w")
+    h.finish(launches=2)
+    h.finish(launches=99)  # second finish must not double-record
+    spans = rec.spans_for(5)
+    assert len(spans) == 1 and spans[0]["attrs"]["launches"] == 2
+
+
+def test_bind_nesting_and_inheritance():
+    rec = SpanRecorder(capacity=16)
+    assert rec.current_trace_id() == 0
+    with rec.bind(11, "node-a"):
+        with rec.span("search.launch") as sp:
+            assert sp.trace_id == 11 and sp.node == "node-a"
+        with rec.bind(22, "node-b"):
+            assert rec.current_trace_id() == 22
+        # inner bind restored
+        assert rec.current_trace_id() == 11
+    assert rec.current_trace_id() == 0
+
+
+def test_ring_bound_counts_drops():
+    rec = SpanRecorder(capacity=4)
+    d0 = metrics.get("spans.dropped")
+    for i in range(10):
+        rec.record("search.launch", time.time(), 0.001, trace_id=i)
+    assert len(rec.recent()) == 4
+    assert metrics.get("spans.dropped") - d0 == 6
+
+
+def test_disabled_recorder_is_noop():
+    rec = SpanRecorder(capacity=16)
+    rec.configure(enabled=False)
+    with rec.span("worker.solve", trace_id=3) as sp:
+        sp.annotate(x=1)  # null span: must not raise
+    rec.record("search.launch", time.time(), 0.1, trace_id=3)
+    rec.event("coord.reassign", trace_id=3)
+    assert rec.recent() == []
+    rec.configure(enabled=True)
+    rec.event("coord.reassign", trace_id=3)
+    assert len(rec.recent()) == 1
+
+
+def test_trace_summaries_rank_by_root_span():
+    rec = SpanRecorder(capacity=64)
+    rec.record("coord.mine", time.time(), 0.5, trace_id=1, node="c")
+    rec.record("worker.solve", time.time(), 9.0, trace_id=1, node="w")
+    rec.record("coord.mine", time.time(), 2.0, trace_id=2, node="c")
+    rec.record("search.launch", time.time(), 0.1, trace_id=3, node="w")
+    summaries = {t["trace_id"]: t for t in rec.trace_summaries()}
+    assert summaries[1]["root"] == "coord.mine"
+    assert summaries[1]["dur_s"] == 0.5  # root span, not slowest member
+    assert summaries[3]["root"] is None
+    assert summaries[3]["dur_s"] == 0.1  # rootless: slowest member
+    slowest = rec.slowest_traces(k=2)
+    assert [t["trace_id"] for t in slowest] == [2, 1]
+    assert all(t["spans"] for t in slowest)  # full trees attached
+
+
+# -- slow-request trigger -----------------------------------------------------
+
+def test_trigger_threshold_arm():
+    t = SlowRequestTrigger(threshold_s=0.5)
+    assert t.armed
+    assert t.observe(0.4) is None
+    assert t.observe(0.6) == "threshold"
+
+
+def test_trigger_disarmed_by_default():
+    t = SlowRequestTrigger()
+    assert not t.armed
+    assert t.observe(100.0) is None
+
+
+def test_trigger_p99_arm_quiet_until_min_samples():
+    t = SlowRequestTrigger(p99_factor=3.0, min_samples=10)
+    for _ in range(9):
+        assert t.observe(0.01) is None  # warming: even a 100x outlier
+    assert t.observe(10.0) is None      # ...9 samples < min: still quiet
+    # window now holds the 10.0 outlier; p99 ~ 10.0, so only > 30 fires
+    assert t.observe(0.02) is None
+    assert t.observe(40.0) == "p99"
+
+
+def test_trigger_sample_does_not_lift_its_own_bar():
+    t = SlowRequestTrigger(p99_factor=2.0, min_samples=5)
+    for _ in range(20):
+        t.observe(0.01)
+    # 1.0 is judged against the PRE-observation window (p99 ~ 0.01)
+    assert t.observe(1.0) == "p99"
+
+
+# -- histogram exemplars ------------------------------------------------------
+
+def test_exemplar_capture_and_snapshot_shape():
+    h = Histogram()
+    h.observe(0.5, trace_id=42)
+    h.observe(0.5)            # no trace: exemplar kept
+    h.observe(0.5, trace_id=43)  # same bucket: last trace wins
+    h.observe(0.0, trace_id=7)   # zero bucket
+    d = h.to_dict()
+    ex = {b: (tid, v) for b, tid, v, _ts in d["exemplars"]}
+    assert ex[0.0] == (7, 0.0)
+    (bucket_bound,) = [b for b in ex if b > 0.0]
+    assert ex[bucket_bound] == (43, 0.5)
+    # exemplars ride only when present
+    assert "exemplars" not in Histogram().to_dict()
+
+
+def test_registry_exemplar_toggle():
+    m = metrics.__class__()
+    m.observe("coord.mine_s.miss", 0.5, trace_id=1)
+    m.exemplars_enabled = False
+    m.observe("coord.mine_s.miss", 0.5, trace_id=2)
+    ex = m.get_histogram("coord.mine_s.miss")["exemplars"]
+    assert ex[0][1] == 1  # the disabled observation left no exemplar
+
+
+def test_exemplar_survives_cluster_merge_freshest_wins():
+    a, b = Histogram(), Histogram()
+    a.observe(0.5, trace_id=1)
+    time.sleep(0.002)
+    b.observe(0.5, trace_id=2)  # fresher observation of the same bucket
+    b.observe(8.0, trace_id=3)
+    merged = merge_histograms([a.to_dict(), b.to_dict()])
+    ex = {b_: tid for b_, tid, _v, _ts in merged["exemplars"]}
+    assert len(ex) == 2
+    assert 2 in ex.values()  # freshest won the shared bucket
+    assert 3 in ex.values()
+    # the merged counts are unchanged by exemplar merging
+    assert merged["count"] == 3
+    # and the windowed view keeps the new snapshot's exemplars
+    delta = delta_histogram(b.to_dict(), a.to_dict())
+    assert {e[1] for e in delta["exemplars"]} == {2, 3}
+
+
+def test_openmetrics_rendering_carries_exemplars():
+    from distpow_tpu.cli.stats import render_prometheus
+
+    h = Histogram()
+    h.observe(0.5, trace_id=77)
+    snap = {"role": "worker",
+            "histograms": {"worker.solve_s": h.to_dict()}}
+    plain = render_prometheus(snap)
+    assert "trace_id" not in plain and "# EOF" not in plain
+    om = render_prometheus(snap, openmetrics=True)
+    assert '# {trace_id="77"} 0.5' in om
+    assert om.rstrip().endswith("# EOF")
+
+
+# -- journal rotation ---------------------------------------------------------
+
+def test_journal_rotation_bounds_disk(tmp_path):
+    from distpow_tpu.runtime.telemetry import FlightRecorder
+
+    rec = FlightRecorder(capacity=64)
+    path = str(tmp_path / "soak.telemetry.jsonl")
+    rec.configure(journal_path=path, journal_interval_s=3600.0,
+                  journal_max_bytes=2048, journal_keep=2)
+    try:
+        for i in range(400):
+            rec.record("soak.event", i=i, pad="x" * 64)
+            if i % 10 == 9:
+                rec.flush_journal()
+    finally:
+        rec.stop()
+    segments = sorted(p for p in os.listdir(tmp_path)
+                      if p.startswith("soak.telemetry.jsonl"))
+    # rotation happened, the keep cap held, and no segment beyond .2
+    assert f"{os.path.basename(path)}.1" in segments
+    assert all(not p.endswith(".3") for p in segments)
+    assert len(segments) <= 3  # live + keep(2)
+    total = sum(os.path.getsize(tmp_path / p) for p in segments)
+    # bounded at ~(keep + 1) x cap plus one flush of slack
+    assert total < 3 * 2048 + 4096
+    # rotated + live lines are valid JSONL and strictly seq-ordered
+    seqs = []
+    for p in (f"{path}.2", f"{path}.1", path):
+        if os.path.exists(p):
+            with open(p) as fh:
+                seqs.extend(json.loads(ln)["seq"] for ln in fh
+                            if ln.strip())
+    assert seqs == sorted(seqs)
+    # the newest events survived rotation (only the oldest were dropped)
+    assert seqs[-1] == 400
+
+
+# -- e2e: spans over a real in-process cluster --------------------------------
+
+def test_mine_records_cross_node_span_tree_and_stitches():
+    SPANS.reset()
+    s = Stack(2, failure_policy="reassign")
+    try:
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x60\x02", 2)
+        assert res.error is None
+        tid = _trace_id(res)
+        spans = SPANS.spans_for(tid)
+        names = _names(spans)
+        assert {"powlib.mine", "coord.mine", "coord.fanout",
+                "coord.first_result", "coord.cancel_storm",
+                "worker.solve", "worker.result_forward"} <= names
+        mine_span = [x for x in spans if x["name"] == "coord.mine"][0]
+        assert mine_span["attrs"]["path"] == "miss"
+        # solve spans carry the shard attribution the forensics verdict
+        # ranks on
+        shards = {x["attrs"]["shard"] for x in spans
+                  if x["name"] == "worker.solve"}
+        assert shards == {0, 1}
+
+        # fetch over the REAL RPC surface and stitch
+        addrs = [s.coord_client_addr] + [w.bound_addr for w in s.workers]
+        fetched = fetch_spans(addrs, trace_id=tid, deadline_s=5.0)
+        assert not fetched["unreachable"]
+        tl = stitch_timeline(fetched, tid)
+        # every node answered with the (shared in-process) ring's union:
+        # the stitch must dedup to distinct spans — never 4x copies.
+        # (Late forwarder acks may legally land between the local read
+        # and the fetch, so compare against uniqueness, not the earlier
+        # snapshot's count.)
+        keys = {(x["node"], x["seq"]) for x in tl["spans"]}
+        assert len(tl["spans"]) == len(keys)
+        assert len(tl["spans"]) >= len(spans)
+        assert tl["slow_shard"] in (0, 1)
+        assert tl["slowest"]["name"] not in ("powlib.mine", "coord.mine")
+        text = render_timeline(tl)
+        assert "slow shard" in text and "coord.first_result" in text
+
+        # summaries sweep finds this trace as the slowest recent one
+        summary = fetch_spans(addrs, deadline_s=5.0)
+        assert slowest_trace_id(summary) == tid
+
+        # exemplars landed on the request histograms with this trace id
+        ex = metrics.get_histogram("coord.mine_s.miss")["exemplars"]
+        assert any(e[1] == tid for e in ex)
+    finally:
+        s.close()
+
+
+def test_forensics_fetch_reports_unreachable_nodes():
+    fetched = fetch_spans(["127.0.0.1:1"], trace_id=1, deadline_s=1.0)
+    assert fetched["nodes"] == {}
+    assert "127.0.0.1:1" in fetched["unreachable"]
+    tl = stitch_timeline(fetched, 1)
+    assert tl["spans"] == [] and tl["unreachable"]
+
+
+class _GatedFinder:
+    """Blocks every search on a release event, then solves (or honors
+    cancellation) — holds a round open so a duplicate can coalesce."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
+        self.entered.set()
+        while not self.release.wait(0.01):
+            if cancel_check and cancel_check():
+                return None
+        if cancel_check and cancel_check():
+            return None
+        return puzzle.python_search(nonce, difficulty, thread_bytes)
+
+
+def test_coalesced_waiter_span_completeness():
+    """PR 4 hard path: the waiter's trace must still carry a complete
+    ``coord.mine`` span — tagged coalesced — even though it never led a
+    fan-out; the leader's trace carries the round spans."""
+    SPANS.reset()
+    s = Stack(1, failure_policy="reassign")
+    gate = _GatedFinder()
+    try:
+        s.workers[0].handler.backend = gate
+        client = s.new_client("client1")
+        c0 = metrics.get("sched.coalesced_requests")
+        client.mine(b"\x61\x01", 1)
+        assert gate.entered.wait(10.0)
+        client.mine(b"\x61\x01", 1)  # identical: coalesces as a waiter
+        deadline = time.monotonic() + 10.0
+        while metrics.get("sched.coalesced_requests") == c0:
+            assert time.monotonic() < deadline, "duplicate never coalesced"
+            time.sleep(0.01)
+        gate.release.set()
+        r1 = client.notify_queue.get(timeout=30)
+        r2 = client.notify_queue.get(timeout=30)
+        assert r1.error is None and r2.error is None
+        tids = {_trace_id(r1), _trace_id(r2)}
+        assert len(tids) == 2
+        waiter = leader = None
+        for tid in tids:
+            spans = SPANS.spans_for(tid)
+            mine = [x for x in spans if x["name"] == "coord.mine"]
+            assert len(mine) == 1, f"trace {tid} missing its mine span"
+            if mine[0]["attrs"].get("coalesced"):
+                waiter = (tid, spans, mine[0])
+            else:
+                leader = (tid, spans, mine[0])
+        assert waiter is not None and leader is not None
+        assert waiter[2]["attrs"]["path"] == "hit"
+        assert "coord.fanout" not in _names(waiter[1])
+        assert {"coord.fanout", "coord.first_result",
+                "coord.cancel_storm"} <= _names(leader[1])
+    finally:
+        s.close()
+
+
+def test_mid_round_reassignment_records_span():
+    """PR 8 hard path: a dead worker's shard moving to a live one must
+    leave a ``coord.reassign`` marker on the request's timeline."""
+    SPANS.reset()
+    s = Stack(2, failure_policy="reassign", failure_probe_secs=0.2)
+    try:
+        s.workers[1].shutdown()  # shard 1's owner is gone before fan-out
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x62\x03", 1, timeout=30)
+        assert res.error is None
+        tid = _trace_id(res)
+        spans = SPANS.spans_for(tid)
+        re_spans = [x for x in spans if x["name"] == "coord.reassign"]
+        assert re_spans, f"no reassign span in {_names(spans)}"
+        assert re_spans[0]["attrs"]["shard"] == 1
+        assert re_spans[0]["attrs"]["to_byte"] == 0
+    finally:
+        s.close()
+
+
+def test_hedged_duplicate_shard_records_span():
+    """PR 8 hard path: a straggler's hedged duplicate shard must leave
+    a ``fleet.hedge`` marker on the request timeline naming owner and
+    target, and the round's solve span comes from the hedge target."""
+    from fleet_helpers import ShardGatedBackend
+    from test_fleet import _elastic_worker
+
+    SPANS.reset()
+    owner = helper = None
+    s = Stack(0, failure_policy="reassign", failure_probe_secs=0.2,
+              coord_extra={"FleetLeaseTTLS": 30.0,
+                           "FleetHedgeMultiple": 2.0})
+    try:
+        owner = _elastic_worker(s, "owner", heartbeat_s=0.1)
+        helper = _elastic_worker(s, "helper", heartbeat_s=0.1)
+        # n=2 split: owner (registered first) owns 0..127 — the only
+        # shard ShardGatedBackend can solve
+        owner.handler.backend = ShardGatedBackend(frozen=True)
+        helper.handler.backend = ShardGatedBackend()
+        owner.fleet_agent.pause()  # beats stop: hedge-stale soon
+        time.sleep(0.3)
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x66\x03", 2, timeout=20)
+        assert res.error is None
+        tid = _trace_id(res)
+        spans = SPANS.spans_for(tid)
+        hedges = [x for x in spans if x["name"] == "fleet.hedge"]
+        assert hedges, f"no hedge span in {_names(spans)}"
+        assert hedges[0]["attrs"]["shard"] == 0
+        assert hedges[0]["attrs"]["owner_byte"] != \
+            hedges[0]["attrs"]["target_byte"]
+        # the hedge target's solve span carries the duplicated shard
+        solves = [x for x in spans if x["name"] == "worker.solve"
+                  and x["attrs"].get("outcome") == "found"]
+        assert any(x["attrs"]["shard"] == 0 and x["node"] == "helper"
+                   for x in solves)
+        owner.fleet_agent.resume()
+    finally:
+        for w in (owner, helper):
+            if w is not None:
+                w.shutdown()
+        s.close()
+
+
+def test_slow_request_auto_capture_e2e():
+    """A Mine slower than ForensicsSlowS lands a forensics.slow_request
+    flight-recorder event carrying the span tree."""
+    SPANS.reset()
+    s = Stack(1, failure_policy="reassign",
+              coord_extra={"ForensicsSlowS": 0.05})
+    gate = _GatedFinder()
+    try:
+        s.workers[0].handler.backend = gate
+        client = s.new_client("client1")
+        cap0 = metrics.get("forensics.slow_captures")
+        client.mine(b"\x63\x01", 1)
+        assert gate.entered.wait(10.0)
+        time.sleep(0.1)  # hold the round past the 50 ms budget
+        gate.release.set()
+        res = client.notify_queue.get(timeout=30)
+        assert res.error is None
+        tid = _trace_id(res)
+        assert metrics.get("forensics.slow_captures") == cap0 + 1
+        evs = [e for e in RECORDER.recent()
+               if e["kind"] == "forensics.slow_request"
+               and e["trace_id"] == tid]
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["reason"] == "threshold" and ev["dur_s"] >= 0.05
+        assert {"coord.fanout", "worker.solve"} <= _names(ev["spans"])
+        json.dumps(ev)  # the capture must be journal/dump-able
+    finally:
+        s.close()
+
+
+def test_sched_slot_span_records_residency():
+    """The scheduler's cross-thread slot span (the tree's one justified
+    SPANS.begin) finishes with launches/preemptions/outcome."""
+    from distpow_tpu.sched.engine import BatchingScheduler
+
+    SPANS.reset()
+    sched = BatchingScheduler(batch_size=1 << 14, max_slots=2)
+    try:
+        with SPANS.bind(424242, "w-test"):
+            secret = sched.search(b"\x64\x01", 1, list(range(256)))
+        assert secret is not None
+        (slot_span,) = [x for x in SPANS.spans_for(424242)
+                        if x["name"] == "sched.slot"]
+        assert slot_span["node"] == "w-test"
+        assert slot_span["attrs"]["outcome"] == "found"
+        assert slot_span["attrs"]["launches"] >= 1
+        assert slot_span["attrs"]["preemptions"] == 0
+    finally:
+        sched.close()
+
+
+def test_spans_rpc_summaries_over_rpc():
+    SPANS.reset()
+    s = Stack(1)
+    try:
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x65\x02", 1)
+        tid = _trace_id(res)
+        from distpow_tpu.runtime.rpc import RPCClient
+
+        c = RPCClient(s.coord_client_addr)
+        try:
+            reply = c.call("Node.Spans", {}, timeout=5.0)
+            assert reply["node"] == "coordinator"
+            assert any(t["trace_id"] == tid for t in reply["traces"])
+            reply = c.call("Node.Spans", {"trace_id": tid, "limit": 4},
+                           timeout=5.0)
+            assert 0 < len(reply["spans"]) <= 4
+        finally:
+            c.close()
+    finally:
+        s.close()
+
+
+def test_slo_breach_dump_attaches_slow_request_timelines(tmp_path):
+    """ISSUE 14: breach evidence carries the top-k slowest request
+    span trees, not just round milestones."""
+    from distpow_tpu.obs.slo import SLOEngine, load_slo_config
+
+    SPANS.reset()
+    SPANS.record("coord.mine", time.time(), 3.0, trace_id=91, node="c",
+                 path="miss")
+    SPANS.record("worker.solve", time.time(), 2.5, trace_id=91, node="w",
+                 shard=2)
+    SPANS.record("coord.mine", time.time(), 0.5, trace_id=92, node="c",
+                 path="miss")
+    RECORDER.reset()
+    RECORDER.configure(dump_dir=str(tmp_path))
+    h = Histogram()
+    for _ in range(20):
+        h.observe(5.0)
+    merged = {"ts": 1.0, "counters": {},
+              "histograms": {"coord.mine_s.miss": h.to_dict()},
+              "stale_nodes": []}
+    cfg = load_slo_config({"objectives": [
+        {"name": "p95", "histogram": "coord.mine_s.miss",
+         "stat": "p95", "max": 1.0}]})
+    v = SLOEngine(cfg).evaluate(merged)
+    assert v.status == "breach" and v.dump_path
+    payload = json.loads(open(v.dump_path).read())
+    slow = payload["extra"]["slow_requests"]
+    assert slow[0]["trace_id"] == 91  # slowest first
+    assert any(sp["name"] == "worker.solve" for sp in slow[0]["spans"])
+
+
+def test_slowest_request_timelines_over_rpc():
+    """The cross-process twin of SPANS.slowest_traces: rank remote
+    traces from a summaries sweep, then fetch each tree."""
+    from distpow_tpu.obs.forensics import slowest_request_timelines
+
+    SPANS.reset()
+    s = Stack(1)
+    try:
+        client = s.new_client("client1")
+        res = mine_and_wait(client, b"\x67\x04", 1)
+        tid = _trace_id(res)
+        out = slowest_request_timelines([s.coord_client_addr], k=3,
+                                        deadline_s=5.0)
+        assert out and out[0]["trace_id"] == tid
+        assert any(sp["name"] == "coord.mine" for sp in out[0]["spans"])
+    finally:
+        s.close()
+
+
+def test_slo_breach_sweeps_remote_spans_when_local_ring_empty(
+        tmp_path, monkeypatch):
+    """The production gate process (cli/slo.py) has no local span ring:
+    on breach the engine must sweep the scraped fleet's Node.Spans for
+    the slow-request evidence instead of silently attaching nothing
+    (review PR 9)."""
+    import distpow_tpu.obs.forensics as forensics
+    from distpow_tpu.obs.slo import SLOEngine, load_slo_config
+
+    canned = [{"trace_id": 7, "dur_s": 2.0,
+               "spans": [{"name": "coord.mine", "trace_id": 7}]}]
+    swept = {}
+
+    def fake_sweep(addrs, k=5, deadline_s=5.0):
+        swept["addrs"] = list(addrs)
+        return canned
+
+    monkeypatch.setattr(forensics, "slowest_request_timelines",
+                        fake_sweep)
+    SPANS.reset()  # the gate process's empty local ring
+    RECORDER.reset()
+    RECORDER.configure(dump_dir=str(tmp_path))
+    h = Histogram()
+    for _ in range(20):
+        h.observe(5.0)
+    merged = {"ts": 1.0, "counters": {},
+              "histograms": {"coord.mine_s.miss": h.to_dict()},
+              "stale_nodes": []}
+    cfg = load_slo_config({"objectives": [
+        {"name": "p95", "histogram": "coord.mine_s.miss",
+         "stat": "p95", "max": 1.0}]})
+    engine = SLOEngine(cfg, span_addrs=["127.0.0.1:9"])
+    v = engine.evaluate(merged)
+    assert v.status == "breach" and v.dump_path
+    assert swept["addrs"] == ["127.0.0.1:9"]
+    payload = json.loads(open(v.dump_path).read())
+    assert payload["extra"]["slow_requests"] == canned
+
+
+# -- trace_profile span-ring input format -------------------------------------
+
+def _spans_payload():
+    return {
+        "format": "spans",
+        "trace_id": 5,
+        "spans": [
+            {"seq": 1, "trace_id": 5, "name": "coord.fanout", "node": "c",
+             "ts": 100.0, "dur_s": 0.01,
+             "attrs": {"round": "r9", "nonce": "aa", "ntz": 2}},
+            {"seq": 2, "trace_id": 5, "name": "coord.first_result",
+             "node": "c", "ts": 100.0, "dur_s": 0.2,
+             "attrs": {"round": "r9", "nonce": "aa", "ntz": 2,
+                       "winner_byte": 1}},
+            {"seq": 3, "trace_id": 5, "name": "coord.cancel_storm",
+             "node": "c", "ts": 100.2, "dur_s": 0.3,
+             "attrs": {"round": "r9", "nonce": "aa", "ntz": 2,
+                       "late_results": 1}},
+            {"seq": 4, "trace_id": 5, "name": "worker.solve", "node": "w",
+             "ts": 100.05, "dur_s": 0.1, "attrs": {"shard": 1}},
+        ],
+    }
+
+
+def test_trace_profile_reads_span_ring_json(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_tp", os.path.join(REPO, "scripts", "trace_profile.py"))
+    tp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tp)
+
+    rounds = tp.profile_spans(_spans_payload())
+    assert len(rounds) == 1
+    r = rounds[0]
+    assert r["round"] == "r9" and r["winner_byte"] == 1
+    assert r["first_result_s"] == 0.2
+    # cancel_propagation re-assembled: first_result + storm (they tile)
+    assert r["cancel_propagation_s"] == 0.5
+    assert r["late_results"] == 1
+
+    # and through the CLI: the shared wall-clock renderer
+    path = tmp_path / "timeline.json"
+    path.write_text(json.dumps(_spans_payload()))
+    assert tp.main([str(path)]) == 0
+    out = json.loads(
+        _capture_main(tp, [str(path), "--json"]))
+    assert out["format"] == "spans"
+    assert out["rounds"][0]["cancel_propagation_s"] == 0.5
+
+
+def _capture_main(mod, argv):
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert mod.main(argv) == 0
+    return buf.getvalue()
