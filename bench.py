@@ -146,7 +146,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                     serving_loop: dict | None = None,
                     load_slo: dict | None = None,
                     membership: dict | None = None,
-                    forensics: dict | None = None):
+                    forensics: dict | None = None,
+                    cluster_scale: dict | None = None):
     """Build the stdout JSON line and the provenance record, once.
 
     Shared by the success path and the hang bailout (review r5: two
@@ -194,6 +195,30 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
     all_suspect.update(suspect)
     md5_acc = {l: v for l, v in accepted.items() if l in MD5_LABELS}
     if not md5_acc:
+        if cluster_scale and not (control_plane or serving_loop
+                                  or load_slo or membership or forensics):
+            # a cluster-scale-only run (bench.py --cluster-scale): the
+            # sixth tunnel-independent perf row (ISSUE 15) — aggregate
+            # open-loop solves/s speedup of the largest coordinator
+            # pool vs one coordinator (the 1.6x/2.5x acceptance floors
+            # are asserted inside the stage).  Kernel provenance stays
+            # untouched (prov None) like the other CPU-only shapes.
+            speedups = cluster_scale.get("speedup") or {}
+            top_key = max(speedups, default=None,
+                          key=lambda k: int(k.split("_")[0][1:]))
+            top_n = int(top_key.split("_")[0][1:]) if top_key else 0
+            line = {
+                "metric": (f"cluster-scale aggregate solves/s speedup, "
+                           f"{top_n}-coordinator pool vs 1 "
+                           "(CPU, tunnel-independent)"),
+                "value": speedups.get(top_key, 0.0) if top_key else 0.0,
+                "unit": "x",
+                "vs_baseline": 0.0,
+                "cluster_scale": cluster_scale,
+            }
+            if note:
+                line["note"] = note
+            return line, None
         if forensics and not (control_plane or serving_loop or load_slo
                               or membership):
             # a forensics-only run (bench.py --forensics-overhead): the
@@ -211,6 +236,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 "vs_baseline": 0.0,
                 "forensics": forensics,
             }
+            if cluster_scale:
+                line["cluster_scale"] = cluster_scale
             if note:
                 line["note"] = note
             return line, None
@@ -242,6 +269,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
             }
             if forensics:
                 line["forensics"] = forensics
+            if cluster_scale:
+                line["cluster_scale"] = cluster_scale
             if note:
                 line["note"] = note
             return line, None
@@ -269,6 +298,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 line["membership"] = membership
             if forensics:
                 line["forensics"] = forensics
+            if cluster_scale:
+                line["cluster_scale"] = cluster_scale
             if note:
                 line["note"] = note
             return line, None
@@ -292,6 +323,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 line["membership"] = membership
             if forensics:
                 line["forensics"] = forensics
+            if cluster_scale:
+                line["cluster_scale"] = cluster_scale
             if note:
                 line["note"] = note
             return line, None
@@ -324,6 +357,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 line["membership"] = membership
             if forensics:
                 line["forensics"] = forensics
+            if cluster_scale:
+                line["cluster_scale"] = cluster_scale
             if note:
                 line["note"] = note
             return line, None
@@ -437,6 +472,11 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
         prov["forensics"] = forensics
     elif (last_measured or {}).get("forensics"):
         prov["forensics"] = last_measured["forensics"]
+    if cluster_scale:
+        line["cluster_scale"] = cluster_scale
+        prov["cluster_scale"] = cluster_scale
+    elif (last_measured or {}).get("cluster_scale"):
+        prov["cluster_scale"] = last_measured["cluster_scale"]
     return line, prov
 
 
@@ -996,6 +1036,156 @@ def load_slo_stage(rates=(6.0, 12.0), duration_s=5.0) -> dict:
     if not out["ok"]:
         print("[bench] WARNING: load-slo stage did not meet its "
               "green-config/oracle acceptance", file=sys.stderr)
+    return out
+
+
+def cluster_scale_stage(pool_sizes=(1, 2, 4), rate_hz=150.0,
+                        duration_s=2.0, max_inflight=4,
+                        retry_after_s=0.05, solve_delay_s=0.15,
+                        drain_timeout_s=60.0) -> dict:
+    """Coordinator scale-out stage (``--cluster-scale``): CPU-only,
+    zero tunnel dependence (ISSUE 15, docs/CLUSTER.md).
+
+    Drives the PR 7 open-loop generator (seeded Poisson arrivals, a
+    miss-dominated blend — the key universe is ~4x the request count,
+    so coalescing and the dominance cache carry almost nothing) against
+    fresh in-process pools of 1, 2 and 4 coordinators sharing one
+    worker fleet, and reports aggregate solves/s per pool size.
+
+    What bounds a pool member is its ADMISSION CAPACITY
+    (``SchedMaxInflight`` — PR 4's model of one process's bounded run
+    queue): each coordinator absorbs ``max_inflight`` concurrent rounds
+    and sheds the rest with server-paced RETRY_AFTER, which the
+    cluster-aware client rides out (sibling hedge, then the server's
+    pacing hint).  Worker solve time is a GIL-releasing stub sleep (the
+    control_plane_stage one-finder idiom) sized to DOMINATE scheduler
+    noise, so aggregate throughput is ``pool x max_inflight /
+    round_time`` by construction and the measured speedup isolates the
+    coordinator plane — exactly the "absorb load instead of shedding
+    it" claim under test.  Acceptance (asserted into ``ok``): 2
+    coordinators >= 1.6x the 1-pool, 4 >= 2.5x (consistent-hash shares
+    are not perfectly equal, so the ideal 2x/4x is not the bound).
+    """
+    from distpow_tpu.load.harness import InProcCluster
+    from distpow_tpu.load.loadgen import LoadMix, OpenLoopRunner, \
+        build_schedule
+    from distpow_tpu.models import puzzle
+
+    stage_t0 = time.time()
+
+    class _DelayFinder:
+        """One-finder stub (control_plane_stage idiom): the finder
+        sleeps the modeled solve time — releasing the GIL, so
+        concurrent rounds genuinely overlap — then solves for real;
+        every other worker honors cancellation."""
+
+        def __init__(self, find: bool, delay_s: float):
+            self._find = find
+            self._delay = delay_s
+
+        def search(self, nonce, difficulty, thread_bytes,
+                   cancel_check=None):
+            if self._find:
+                time.sleep(self._delay)
+                return puzzle.python_search(nonce, difficulty,
+                                            thread_bytes)
+            while not (cancel_check and cancel_check()):
+                time.sleep(0.002)
+            return None
+
+    def run_pool(n_coordinators: int, seed: int) -> dict:
+        import queue as _q
+        cluster = InProcCluster(
+            n_workers=2, backend="python",
+            n_coordinators=n_coordinators,
+            coord_extra={
+                "SchedMaxInflight": max_inflight,
+                "SchedRetryAfterS": retry_after_s,
+            },
+            # the ceiling must outlast a fully queued backlog's worth
+            # of server-paced retries (non-counting for the budget,
+            # counting for the ceiling): 50 retries -> 500 attempts
+            client_extra={"MineRetries": 50},
+        )
+        try:
+            for j, w in enumerate(cluster.workers):
+                w.handler.backend = _DelayFinder(j == 0, solve_delay_s)
+            mix = LoadMix(
+                rate_hz=rate_hz, duration_s=duration_s, seed=seed,
+                n_keys=int(rate_hz * duration_s * 4), zipf_s=0.0,
+                difficulties=((1, 1.0),),
+            )
+            schedule = build_schedule(mix)
+            done = [0]
+            errors = []
+            notify = cluster.client.notify_queue
+            stop = [False]
+
+            def drain():
+                while not stop[0]:
+                    try:
+                        res = notify.get(timeout=0.05)
+                    except _q.Empty:
+                        continue
+                    done[0] += 1
+                    if res.error:
+                        errors.append(str(res.error))
+
+            import threading
+            drainer = threading.Thread(target=drain, daemon=True)
+            drainer.start()
+            t0 = time.monotonic()
+            report = OpenLoopRunner(
+                lambda arr: cluster.client.mine(arr.nonce, arr.ntz)
+            ).run(schedule)
+            expected = report.issued - report.submit_errors
+            deadline = time.monotonic() + drain_timeout_s
+            while done[0] < expected and time.monotonic() < deadline:
+                time.sleep(0.02)
+            wall = time.monotonic() - t0
+            stop[0] = True
+            drainer.join(timeout=1.0)
+            return {
+                "coordinators": n_coordinators,
+                "issued": report.issued,
+                "completed": done[0],
+                "request_errors": len(errors),
+                "error_samples": errors[:3],
+                "wall_s": round(wall, 3),
+                "solves_per_s": round(done[0] / max(wall, 1e-9), 2),
+            }
+        finally:
+            cluster.close()
+
+    out: dict = {
+        "rate_hz": rate_hz, "duration_s": duration_s,
+        "max_inflight": max_inflight, "solve_delay_s": solve_delay_s,
+        "pools": {}, "speedup": {}, "ok": True,
+    }
+    for i, n in enumerate(sorted(pool_sizes)):
+        row = run_pool(n, seed=61 + i)
+        out["pools"][f"n{n}"] = row
+        if row["request_errors"] or row["completed"] < row["issued"]:
+            out["ok"] = False
+        print(f"[bench] cluster-scale {n} coordinator(s): "
+              f"{row['solves_per_s']} solves/s aggregate "
+              f"({row['completed']}/{row['issued']} in "
+              f"{row['wall_s']}s, {row['request_errors']} errors)",
+              file=sys.stderr)
+    base = (out["pools"].get("n1") or {}).get("solves_per_s") or 0.0
+    floors = {2: 1.6, 4: 2.5}
+    for n in sorted(pool_sizes):
+        if n == 1 or not base:
+            continue
+        x = round((out["pools"][f"n{n}"]["solves_per_s"] or 0.0) / base, 2)
+        out["speedup"][f"n{n}_vs_n1"] = x
+        floor = floors.get(n)
+        if floor is not None and x < floor:
+            out["ok"] = False
+            print(f"[bench] WARNING: cluster-scale {n}-pool speedup "
+                  f"{x}x below the {floor}x acceptance floor",
+                  file=sys.stderr)
+    out["wall_s"] = round(time.time() - stage_t0, 1)
     return out
 
 
@@ -1683,6 +1873,18 @@ def main() -> None:
                                   membership=mb)
         print(json.dumps(line))
         return
+    if "--cluster-scale" in sys.argv:
+        # standalone coordinator-pool scaling run (ISSUE 15): CPU-only
+        # by construction — stub-backend workers over localhost RPC,
+        # no jax and no device probe; the 1.6x/2.5x acceptance floors
+        # are asserted inside the stage and the line rides
+        # finalize_record's cluster-scale shape (kernel provenance
+        # untouched)
+        cs = cluster_scale_stage()
+        line, _ = finalize_record({}, _read_last_measured(), None,
+                                  cluster_scale=cs)
+        print(json.dumps(line))
+        return
     if "--forensics-overhead" in sys.argv:
         # standalone forensics-overhead run (ISSUE 14): CPU-only by
         # construction — python-backend workers over localhost RPC, no
@@ -1744,6 +1946,17 @@ def main() -> None:
                 line["metric"] += "; forensics stage measured on CPU"
             except Exception as exc:
                 print(f"[bench] forensics stage failed: {exc}",
+                      file=sys.stderr)
+        if os.environ.get("BENCH_CLUSTER_SCALE") != "0":
+            # sixth tunnel-independent row (ISSUE 15): coordinator-pool
+            # scale-out over the open-loop harness — jax-free like the
+            # control-plane stage, with the 1.6x/2.5x floors asserted
+            # inside the stage
+            try:
+                line["cluster_scale"] = cluster_scale_stage()
+                line["metric"] += "; cluster-scale stage measured on CPU"
+            except Exception as exc:
+                print(f"[bench] cluster-scale stage failed: {exc}",
                       file=sys.stderr)
         if os.environ.get("BENCH_SERVING_LOOP") != "0":
             # same rationale for the serving-loop row (ISSUE 6), but
@@ -2237,13 +2450,28 @@ def main() -> None:
             print(f"[bench] forensics stage failed: {exc}",
                   file=sys.stderr)
 
+    # ---- Cluster-scale stage (CPU, deadline-gated) -------------------
+    # the coordinator scale-out row (ISSUE 15): aggregate open-loop
+    # solves/s across 1/2/4-member pools — stub backends only, so it
+    # runs on healthy rounds too (same carry-forward rationale as the
+    # load-slo stage); the speedup floors are asserted inside the stage
+    cluster_scale = None
+    if os.environ.get("BENCH_CLUSTER_SCALE") != "0" and \
+            time.time() <= deadline:
+        try:
+            cluster_scale = cluster_scale_stage()
+        except Exception as exc:
+            print(f"[bench] cluster-scale stage failed: {exc}",
+                  file=sys.stderr)
+
     # ---- Final line ---------------------------------------------------
     line, prov = finalize_record(rates, last_measured, baseline,
                                  control_plane=control_plane,
                                  serving_loop=serving_loop,
                                  load_slo=load_slo,
                                  membership=membership,
-                                 forensics=forensics)
+                                 forensics=forensics,
+                                 cluster_scale=cluster_scale)
     # the measured roofline rides in provenance: the generated
     # registry-standing table (scripts/gen_registry_table.py) derives
     # utilization percentages from it.  prov is None when no md5 stage

@@ -10,6 +10,7 @@ from . import (
     dead_package,
     hot_path_host_sync,
     metrics_registry,
+    modulo_routing,
     relaunch_loop_sync,
     serial_rpc_fanout,
     silent_except,
@@ -23,6 +24,7 @@ ALL_RULES = (
     bounded_queue,
     serial_rpc_fanout,
     unbounded_thread_spawn,
+    modulo_routing,
     trace_vocabulary,
     metrics_registry,
     config_key_sync,

@@ -8,7 +8,7 @@ slot gets a fresh port.  Keeps the worker list length from the existing
 coordinator config (cmd/config-gen/main.go:51-88).
 
     python -m distpow_tpu.cli.config_gen [--config-dir DIR] [--host HOST]
-        [--workers N] [--elastic]
+        [--workers N] [--elastic] [--coordinators N]
 
 Emitted configs carry the full dataclass field set, so the fleet
 membership knobs (``FleetLeaseTTLS`` / ``FleetHedge`` /
@@ -22,6 +22,18 @@ worker config to ``FleetRegister: true``, the shape an elastic worker
 boots from (``--listen 127.0.0.1:0`` then works: the worker registers
 its real bound port with the coordinator instead of needing a
 pre-agreed one).
+
+``--coordinators N`` (docs/CLUSTER.md) emits an N-member coordinator
+POOL: shard 0 keeps ``coordinator_config.json`` (back-compat) and
+shard ``i>0`` lands in ``coordinator{i}_config.json``; every member
+carries the full ``ClusterPeers`` ring-seed list (all client-facing
+addresses, shard order), its own ``ClusterSelf`` index, its own
+listen ports, and the SAME shared ``Workers`` list.  The client
+config gains ``CoordAddrs`` (the same seed list — powlib cluster
+mode) while ``CoordAddr`` still points at shard 0 for pre-cluster
+tools; the worker config's ``CoordAddr`` points at shard 0's worker
+API (pooled rounds stamp their own reply-to, so the default only
+matters for which coordinator a static worker appears under).
 """
 
 from __future__ import annotations
@@ -54,6 +66,10 @@ def main(argv=None) -> None:
     ap.add_argument("--elastic", action="store_true",
                     help="emit the worker config with FleetRegister=true "
                          "(lease-based membership, docs/FLEET.md)")
+    ap.add_argument("--coordinators", type=int, default=1,
+                    help="coordinator pool size (docs/CLUSTER.md): >1 "
+                         "emits per-shard coordinator configs with ring "
+                         "seeds and flips the client to CoordAddrs")
     ap.add_argument("--seed", type=int, default=None)
     args = ap.parse_args(argv)
     rng = random.Random(args.seed)
@@ -68,21 +84,49 @@ def main(argv=None) -> None:
         path = os.path.join(d, name)
         return read_json_config(path, cls) if os.path.exists(path) else cls()
 
+    n_coords = max(1, int(args.coordinators))
     tracer_addr = addr()
-    coord_client_addr = addr()
-    coord_worker_addr = addr()
+    client_addrs = [addr() for _ in range(n_coords)]
+    worker_api_addrs = [addr() for _ in range(n_coords)]
+    coord_client_addr = client_addrs[0]
+    coord_worker_addr = worker_api_addrs[0]
 
     ts = load("tracing_server_config.json", TracingServerConfig)
     ts.ServerBind = tracer_addr
     write_json_config(os.path.join(d, "tracing_server_config.json"), ts)
 
+    def coord_path(i: int) -> str:
+        # shard 0 keeps the historical name so pre-cluster tooling
+        # (cli.coordinator default --config, the reference scripts)
+        # still finds a coordinator
+        return os.path.join(
+            d, "coordinator_config.json" if i == 0
+            else f"coordinator{i}_config.json")
+
     coord = load("coordinator_config.json", CoordinatorConfig)
     n = args.workers or len(coord.Workers) or 4
-    coord.Workers = [addr() for _ in range(n)]
-    coord.TracerServerAddr = tracer_addr
-    coord.ClientAPIListenAddr = coord_client_addr
-    coord.WorkerAPIListenAddr = coord_worker_addr
-    write_json_config(os.path.join(d, "coordinator_config.json"), coord)
+    shared_workers = [addr() for _ in range(n)]
+    for i in range(n_coords):
+        c = load("coordinator_config.json", CoordinatorConfig) \
+            if i else coord
+        c.Workers = list(shared_workers)  # ONE fleet, shared by the pool
+        c.TracerServerAddr = tracer_addr
+        c.ClientAPIListenAddr = client_addrs[i]
+        c.WorkerAPIListenAddr = worker_api_addrs[i]
+        if n_coords > 1:
+            c.ClusterPeers = list(client_addrs)
+            c.ClusterSelf = i
+            if i and c.CacheFile:
+                # per-process paths: two shards appending one cache
+                # journal (and deriving one restart epoch) would
+                # corrupt both — suffix everything i>0 inherits
+                c.CacheFile = f"{c.CacheFile}.c{i}"
+            if i and c.TelemetryDir:
+                c.TelemetryDir = os.path.join(c.TelemetryDir, f"c{i}")
+        else:
+            c.ClusterPeers = []
+            c.ClusterSelf = -1
+        write_json_config(coord_path(i), c)
 
     for name in ("client_config.json", "client2_config.json"):
         c = load(name, ClientConfig)
@@ -90,6 +134,7 @@ def main(argv=None) -> None:
             c.ClientID = "client2"
         c.TracerServerAddr = tracer_addr
         c.CoordAddr = coord_client_addr
+        c.CoordAddrs = list(client_addrs) if n_coords > 1 else []
         write_json_config(os.path.join(d, name), c)
 
     w = load("worker_config.json", WorkerConfig)
@@ -100,9 +145,11 @@ def main(argv=None) -> None:
         w.FleetRegister = True
     write_json_config(os.path.join(d, "worker_config.json"), w)
 
+    pool = (f" pool={n_coords} coordinators, ring seeds {client_addrs}"
+            if n_coords > 1 else "")
     print(f"wrote configs to {d}: tracer={tracer_addr} "
           f"coordinator client={coord_client_addr} worker={coord_worker_addr} "
-          f"workers={coord.Workers} "
+          f"workers={shared_workers}{pool} "
           f"(fleet: lease ttl {coord.FleetLeaseTTLS}s, hedge "
           f"{'on' if coord.FleetHedge else 'off'}, elastic worker "
           f"{'yes' if w.FleetRegister else 'no'})")

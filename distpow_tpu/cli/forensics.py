@@ -46,9 +46,13 @@ def main(argv=None) -> int:
     ap.add_argument("--addr", action="append", default=None,
                     help="node RPC address host:port (repeatable; each "
                          "flag may hold a comma list)")
-    ap.add_argument("--discover", metavar="COORD_ADDR", default=None,
-                    help="pull the sweep list from the coordinator's "
-                         "live membership table (Fleet.Members)")
+    ap.add_argument("--discover", metavar="COORD_ADDR", action="append",
+                    default=None,
+                    help="pull the sweep list from the coordinators' "
+                         "live membership tables (Fleet.Members, "
+                         "dedup-merged across the pool — one member of "
+                         "a sharded pool names the rest via the ring; "
+                         "docs/CLUSTER.md); repeatable, comma lists ok")
     ap.add_argument("--trace", type=int, default=None,
                     help="trace id to stitch; omitted = the slowest "
                          "recent trace any swept node remembers")
@@ -75,9 +79,10 @@ def main(argv=None) -> int:
         try:
             discovered = discover_cluster_addrs(args.discover,
                                                 timeout=args.deadline)
-        except (OSError, RPCError) as exc:
-            print(f"error: membership discovery against {args.discover} "
-                  f"failed: {exc}", file=sys.stderr)
+        except (OSError, RPCError, RuntimeError) as exc:
+            print(f"error: membership discovery against "
+                  f"{','.join(args.discover)} failed: {exc}",
+                  file=sys.stderr)
             return 1
         addrs = discovered + [a for a in addrs if a not in discovered]
     if not addrs:

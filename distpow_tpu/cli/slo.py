@@ -38,8 +38,16 @@ def main(argv=None) -> int:
         description="evaluate cluster SLOs over merged node metrics")
     ap.add_argument("--config", required=True,
                     help="SLO config JSON (see config/slo.json)")
-    ap.add_argument("--addr", required=True, action="append",
+    ap.add_argument("--addr", action="append", default=None,
                     help="node RPC address (repeatable; comma lists ok)")
+    ap.add_argument("--discover", metavar="COORD_ADDR", action="append",
+                    default=None,
+                    help="pull the sweep list from the coordinators' "
+                         "live membership tables (Fleet.Members, "
+                         "dedup-merged across the pool — one member of "
+                         "a sharded pool names the rest via the ring; "
+                         "docs/CLUSTER.md); repeatable, comma lists ok. "
+                         "Extra --addr flags merge in.")
     ap.add_argument("--role", choices=["auto", "coordinator", "worker"],
                     default="auto")
     ap.add_argument("--deadline", type=float, default=5.0,
@@ -52,9 +60,24 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="print the typed verdict as JSON")
     args = ap.parse_args(argv)
-    addrs = [a for flag in args.addr for a in flag.split(",") if a]
+    addrs = [a for flag in (args.addr or []) for a in flag.split(",") if a]
     if args.interval is not None and args.interval <= 0:
         ap.error("--interval SECS must be positive")
+    if args.discover:
+        from ..runtime.rpc import RPCError
+        from .stats import discover_cluster_addrs
+
+        try:
+            discovered = discover_cluster_addrs(args.discover,
+                                                timeout=args.deadline)
+        except (OSError, RPCError, RuntimeError) as exc:
+            print(f"error: membership discovery against "
+                  f"{','.join(args.discover)} failed: {exc}",
+                  file=sys.stderr)
+            return 2
+        addrs = discovered + [a for a in addrs if a not in discovered]
+    if not addrs:
+        ap.error("--addr (or --discover) is required")
 
     try:
         config = load_slo_config(args.config)

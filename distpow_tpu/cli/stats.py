@@ -53,6 +53,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import threading
 import time
 from concurrent.futures import TimeoutError as FutureTimeout
 
@@ -85,23 +86,98 @@ def fetch_stats(addr: str, role: str = "auto", timeout: float = 5.0) -> dict:
         client.close()
 
 
-def discover_cluster_addrs(coord_addr: str, timeout: float = 5.0) -> list:
-    """Coordinator's live membership -> scrape address list
-    (``Fleet.Members``; docs/FLEET.md).  The coordinator itself leads
-    the list; every current member follows in table order.  Draining
-    members are still scraped (they serve until their lease releases);
-    expired ones are already gone from the table."""
-    client = RPCClient(coord_addr, timeout=timeout, codec="json")
-    try:
-        table = client.call("Fleet.Members", {}, timeout=timeout)
-    finally:
-        client.close()
-    addrs = [coord_addr]
-    for m in table.get("workers") or []:
-        a = m.get("addr")
-        if a and a not in addrs:
-            addrs.append(a)
+def discover_cluster_addrs(coord_addrs, timeout: float = 5.0) -> list:
+    """Coordinator membership -> scrape address list (``Fleet.Members``
+    dedup-merged across the POOL; docs/FLEET.md, docs/CLUSTER.md).
+
+    ``coord_addrs``: one address (the historical shape) or a list of
+    coordinator addresses.  The pool is expanded first: any member's
+    Stats snapshot names the whole ring (the coordinator ``cluster``
+    key), so ONE seed suffices to cover a sharded pool — and the probe
+    rides the error-free ``Node.Stats`` path, never minting
+    ``rpc.handler_errors`` on the node being observed (the
+    watcher-perturbation class docs/SLO.md documents).  Every reachable
+    pool coordinator's ``Fleet.Members`` is then merged with
+    de-duplication, so the sweep covers all coordinators plus every
+    current member — static and lease-registered alike — across all
+    shards.  Draining members are still scraped (they serve until their
+    lease releases); expired ones are already gone from the tables.
+    Raises only when NO coordinator answered; a partially-dead pool
+    still yields the survivors' view.
+    """
+    seeds = ([coord_addrs] if isinstance(coord_addrs, str)
+             else list(coord_addrs))
+    coords: list = []
+    for flag in seeds:
+        for a in flag.split(","):
+            if a and a not in coords:
+                coords.append(a)
+    # pool expansion via the ring advertised in Stats snapshots —
+    # probed CONCURRENTLY under one shared deadline (the FleetScraper
+    # discipline): a frozen pool member must cost the sweep at most
+    # one timeout total, not one per serial probe (review PR 10)
+    expansion = _concurrent_probe(
+        coords, lambda a: fetch_stats(a, timeout=timeout), timeout)
+    for a in list(coords):
+        snap = expansion.get(a)
+        if not isinstance(snap, dict):
+            continue
+        ring = (snap.get("cluster") or {}).get("ring") or {}
+        for _member, addr in ring.get("members") or []:
+            if addr and addr not in coords:
+                coords.append(addr)
+    addrs = list(coords)
+
+    def members_of(coord: str) -> dict:
+        client = RPCClient(coord, timeout=timeout, codec="json")
+        try:
+            return client.call("Fleet.Members", {}, timeout=timeout)
+        finally:
+            client.close()
+
+    tables = _concurrent_probe(coords, members_of, timeout)
+    reached = 0
+    last_exc: Exception = RuntimeError("no coordinator addresses given")
+    for coord in coords:
+        table = tables.get(coord)
+        if not isinstance(table, dict):
+            if isinstance(table, Exception):
+                last_exc = table
+            elif table is None and coords:
+                last_exc = RuntimeError(
+                    f"{coord} missed the {timeout}s discovery deadline")
+            continue
+        reached += 1
+        for m in table.get("workers") or []:
+            a = m.get("addr")
+            if a and a not in addrs:
+                addrs.append(a)
+    if not reached:
+        raise last_exc
     return addrs
+
+
+def _concurrent_probe(addrs, fn, deadline_s: float) -> dict:
+    """Run ``fn(addr)`` for every address on its own thread and join
+    them all under ONE shared deadline — addr -> result dict, with
+    exceptions held as values and deadline-missers absent.  Threads
+    are daemons, so an abandoned slow probe cannot pin the CLI."""
+    results: dict = {}
+
+    def one(a):
+        try:
+            results[a] = fn(a)
+        except Exception as exc:
+            results[a] = exc
+
+    threads = [threading.Thread(target=one, args=(a,), daemon=True)
+               for a in addrs]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + deadline_s
+    for t in threads:
+        t.join(timeout=max(0.05, deadline - time.monotonic()))
+    return dict(results)
 
 
 def _prom_name(name: str) -> str:
@@ -246,10 +322,15 @@ def main(argv=None) -> int:
     ap.add_argument("--addr", action="append", default=None,
                     help="node RPC address host:port (repeatable with "
                          "--cluster; each flag may hold a comma list)")
-    ap.add_argument("--discover", metavar="COORD_ADDR", default=None,
+    ap.add_argument("--discover", metavar="COORD_ADDR", action="append",
+                    default=None,
                     help="with --cluster: pull the scrape list from the "
-                         "coordinator's live membership table "
-                         "(Fleet.Members) instead of --addr flags")
+                         "coordinators' live membership tables "
+                         "(Fleet.Members, dedup-merged across the pool) "
+                         "instead of --addr flags; repeatable, comma "
+                         "lists ok — one member of a sharded pool is "
+                         "enough, the ring names the rest "
+                         "(docs/CLUSTER.md)")
     ap.add_argument("--role", choices=["auto", "coordinator", "worker"],
                     default="auto")
     ap.add_argument("--timeout", type=float, default=5.0)
@@ -289,9 +370,10 @@ def main(argv=None) -> int:
             try:
                 discovered = discover_cluster_addrs(
                     args.discover, timeout=args.timeout)
-            except (OSError, RPCError, FutureTimeout) as exc:
+            except (OSError, RPCError, FutureTimeout, RuntimeError) as exc:
                 print(f"error: membership discovery against "
-                      f"{args.discover} failed: {exc}", file=sys.stderr)
+                      f"{','.join(args.discover)} failed: {exc}",
+                      file=sys.stderr)
                 return 1
             # explicit --addr extras merge in after the discovered set
             addrs = discovered + [a for a in addrs if a not in discovered]

@@ -1,0 +1,22 @@
+"""Coordinator scale-out plane (docs/CLUSTER.md).
+
+A pool of N coordinators partitions the Mine keyspace by consistent
+hashing over the nonce (ring.py), advertises the ring through the
+extended ``rpc.hello`` ack and the ``Cluster.Ring`` RPC (service.py),
+and redirects misrouted keys with a typed ``NOT_OWNER`` reply carrying
+a fresh ring snapshot.  powlib (nodes/powlib.py) is the cluster-aware
+client: owner routing, hedged sibling retry on RETRY_AFTER, and
+ring-guided failover when a shard dies.
+"""
+
+from .ring import DEFAULT_VNODES, HashRing, ring_from_peers
+from .service import ClusterService, ClusterState, NotOwnerError
+
+__all__ = [
+    "DEFAULT_VNODES",
+    "HashRing",
+    "ring_from_peers",
+    "ClusterService",
+    "ClusterState",
+    "NotOwnerError",
+]

@@ -1,0 +1,77 @@
+"""Coordinator-side cluster plane: shard identity + the ``Cluster`` RPC.
+
+One :class:`ClusterState` per pooled coordinator binds the pool's
+:class:`..cluster.ring.HashRing` to this process's own member id.  The
+coordinator consults it at the top of every Mine (nodes/coordinator.py):
+a key the ring maps elsewhere earns a typed :class:`NotOwnerError`
+redirect carrying a fresh ring snapshot — the client adopts the
+snapshot and re-routes without a second discovery round trip.  A Mine
+carrying ``no_redirect`` (powlib's hedged sibling retries and
+failover sends) is served even when foreign: every coordinator fans
+out over the SAME shared worker fleet, so correctness never depends on
+ownership — only dominance-cache locality does.
+
+The ``Cluster.Ring`` RPC (registered on both coordinator listeners)
+serves the snapshot on demand; the same snapshot rides the extended
+``rpc.hello`` ack (runtime/rpc.py ``hello_extra``), so a freshly dialed
+client learns the ring in its very first exchange.
+"""
+
+from __future__ import annotations
+
+from ..runtime.metrics import REGISTRY as metrics
+from .ring import HashRing
+
+
+class NotOwnerError(Exception):
+    """This coordinator does not own the request's nonce.
+
+    Duck-typed by the RPC layer exactly like the admission plane's
+    ``retry_after_s`` (runtime/rpc.py must not import cluster): the
+    ``ring_wire`` attribute ships as the response frame's dedicated
+    ``ring`` field, and the client surfaces the pair as a typed
+    ``RPCNotOwner`` — machine-readable redirect, not a string to parse.
+    """
+
+    def __init__(self, owner: str, ring_wire: dict):
+        super().__init__(
+            f"NOT_OWNER: key is owned by shard {owner!r} "
+            f"(ring v{ring_wire.get('version', 0)})"
+        )
+        self.owner = owner
+        self.ring_wire = ring_wire
+
+
+class ClusterState:
+    """This coordinator's view of the pool: the ring + its own id."""
+
+    __slots__ = ("ring", "self_id")
+
+    def __init__(self, ring: HashRing, self_id: str):
+        if ring.addr_of(self_id) is None:
+            raise ValueError(
+                f"self id {self_id!r} is not a ring member "
+                f"({ring.member_ids()})"
+            )
+        self.ring = ring
+        self.self_id = self_id
+
+    def owns(self, nonce: bytes) -> bool:
+        return self.ring.owner(nonce) == self.self_id
+
+    def hello_extra(self) -> dict:
+        """Payload merged into the ``rpc.hello`` ack (runtime/rpc.py):
+        the ring reaches every dialing client in exchange zero."""
+        return {"ring": self.ring.to_wire()}
+
+
+class ClusterService:
+    """The ``Cluster`` RPC service (``Cluster.Ring``)."""
+
+    def __init__(self, state: ClusterState):
+        self._state = state
+
+    def Ring(self, params) -> dict:
+        metrics.inc("cluster.ring_serves")
+        return {"ring": self._state.ring.to_wire(),
+                "self": self._state.self_id}

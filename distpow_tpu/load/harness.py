@@ -51,32 +51,52 @@ def exact_percentile(samples: List[float], q: float) -> Optional[float]:
 
 
 class InProcCluster:
-    """coordinator + N workers + one client, all in this process.
+    """coordinator pool + N shared workers + one client, all in this
+    process.
 
     The production shape of tests/test_nodes.py's Stack, packaged as
     product code so bench.py and the CI smoke need no test imports.
     Binds on ':0' and wires real addresses afterwards — no port races.
+
+    ``n_coordinators > 1`` boots the scale-out shape (docs/CLUSTER.md):
+    a pool of coordinators over ONE shared worker fleet, each member's
+    ring installed via ``set_cluster_peers`` once the real client
+    addresses exist, and the client in powlib cluster mode (consistent-
+    hash routing, sibling hedging, failover).  ``n_coordinators=1``
+    keeps the historical single-coordinator cluster byte-identical.
     """
 
     def __init__(self, n_workers: int = 2, backend: str = "python",
                  coord_extra: Optional[dict] = None,
                  worker_extra: Optional[dict] = None,
-                 client_extra: Optional[dict] = None):
-        self.coordinator = Coordinator(CoordinatorConfig(
-            ClientAPIListenAddr="127.0.0.1:0",
-            WorkerAPIListenAddr="127.0.0.1:0",
-            Workers=["pending:0"] * n_workers,
-            **(coord_extra or {}),
-        ))
-        client_addr, worker_api = self.coordinator.initialize_rpcs()
-        self.client_addr = client_addr
+                 client_extra: Optional[dict] = None,
+                 n_coordinators: int = 1):
+        self.coordinators: List[Coordinator] = [
+            Coordinator(CoordinatorConfig(
+                ClientAPIListenAddr="127.0.0.1:0",
+                WorkerAPIListenAddr="127.0.0.1:0",
+                Workers=["pending:0"] * n_workers,
+                **(coord_extra or {}),
+            ))
+            for _ in range(n_coordinators)
+        ]
+        self.coordinator = self.coordinators[0]  # back-compat alias
+        bound = [c.initialize_rpcs() for c in self.coordinators]
+        self.client_addrs = [client for client, _worker in bound]
+        self.client_addr = self.client_addrs[0]
+        if n_coordinators > 1:
+            for i, c in enumerate(self.coordinators):
+                c.set_cluster_peers(self.client_addrs, i)
         self.workers: List[Worker] = []
         addrs = []
         for i in range(n_workers):
             w = Worker(WorkerConfig(
                 WorkerID=f"loadw{i}",
                 ListenAddr="127.0.0.1:0",
-                CoordAddr=worker_api,
+                # the config default delivery target; pooled rounds
+                # stamp their own reply-to, so every member receives
+                # its rounds' Results regardless of this choice
+                CoordAddr=bound[0][1],
                 Backend=backend,
                 WarmupNonceLens=[],
                 WarmupWidths=[],
@@ -86,20 +106,27 @@ class InProcCluster:
             w.start_forwarder()
             self.workers.append(w)
         self.worker_addrs = addrs
-        self.coordinator.set_worker_addrs(addrs)
+        for c in self.coordinators:
+            c.set_worker_addrs(addrs)
         # the open-loop client: a deep notify queue — the drain runs on
         # one harness thread and a bounded default (10) would make
         # powlib's delivery the closed-loop throttle the generator
-        # exists to avoid
+        # exists to avoid.  A pool rides CoordAddrs (powlib cluster
+        # mode); a single coordinator keeps the plain CoordAddr shape.
         self.client = Client(ClientConfig(
-            ClientID="loadgen", CoordAddr=client_addr,
+            ClientID="loadgen", CoordAddr=self.client_addr,
+            CoordAddrs=self.client_addrs if n_coordinators > 1 else [],
             ChCapacity=100_000, **(client_extra or {}),
         ))
         self.client.initialize()
 
     def scrape_targets(self, include_workers: bool = False) -> List[NodeTarget]:
-        targets = [NodeTarget(addr=self.client_addr, name="coordinator",
-                              role="coordinator")]
+        targets = [
+            NodeTarget(addr=a, name=(f"coordinator{i}" if i else
+                                     "coordinator"),
+                       role="coordinator")
+            for i, a in enumerate(self.client_addrs)
+        ]
         if include_workers:
             targets.extend(
                 NodeTarget(addr=a, name=w.config.WorkerID, role="worker")
@@ -111,7 +138,8 @@ class InProcCluster:
         self.client.close()
         for w in self.workers:
             w.shutdown()
-        self.coordinator.shutdown()
+        for c in self.coordinators:
+            c.shutdown()
 
 
 class _CompletionTracker:
@@ -183,7 +211,10 @@ def run_load_slo(
     # include_worker_targets only adds the multi-node sweep used for
     # the scale-invariant merge-vs-single-node cross-check below
     scraper = FleetScraper(
-        cluster.scrape_targets(include_workers=False),
+        # first coordinator only: under an in-process pool every member
+        # shares the one registry, so sweeping them all would multiply
+        # the judged counters by the pool size (module docstring)
+        cluster.scrape_targets(include_workers=False)[:1],
         deadline_s=scrape_deadline_s,
     )
     engine = SLOEngine(config)

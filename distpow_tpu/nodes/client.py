@@ -30,9 +30,18 @@ class Client:
         if self._initialized:
             raise RuntimeError("client has been initialized before")
         # coordinator-outage resilience knobs ride the config
-        # (nodes/powlib.py module docstring; defaults in ClientConfig)
+        # (nodes/powlib.py module docstring; defaults in ClientConfig).
+        # CoordAddrs (>= 2 entries) flips powlib into cluster mode —
+        # consistent-hash routing over the coordinator pool
+        # (docs/CLUSTER.md); otherwise the single CoordAddr keeps the
+        # historical behavior byte-identical.
+        # a one-entry pool still names a valid coordinator (powlib
+        # collapses it to plain single mode) — falling through to a
+        # possibly-empty CoordAddr would discard it (review PR 10)
+        pool = list(getattr(self.config, "CoordAddrs", []) or [])
         self.notify_queue = self.pow.initialize(
-            self.config.CoordAddr, self.config.ChCapacity,
+            pool if pool else self.config.CoordAddr,
+            self.config.ChCapacity,
             retries=getattr(self.config, "MineRetries", None),
             backoff_s=getattr(self.config, "MineBackoffS", None),
             backoff_max_s=getattr(self.config, "MineBackoffMaxS", None),

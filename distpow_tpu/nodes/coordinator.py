@@ -77,6 +77,8 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Dict, List, Optional, Tuple
 
+from ..cluster import ClusterService, ClusterState, NotOwnerError, \
+    ring_from_peers
 from ..fleet.membership import FleetRegistry, FleetService, RoundPlan
 from ..parallel.partition import worker_bits as partition_worker_bits
 from ..runtime import actions as act
@@ -192,7 +194,7 @@ def load_restart_epoch(path: Optional[str]) -> int:
     return epoch
 
 
-def new_round_id(epoch: int = 0) -> str:
+def new_round_id(epoch: int = 0, namespace: str = "") -> str:
     """Fan-out-round id: fixed-width hex, LEXICOGRAPHICALLY ordered by
     issue order.  Workers rely on the order to resolve a round mismatch:
     a Found tagged newer than the task-table entry proves the entry is a
@@ -206,11 +208,21 @@ def new_round_id(epoch: int = 0) -> str:
     monotonic even if the wall clock steps backward (NTP).  Coordinators
     without a CacheFile run at epoch 0 — there ordering across restarts
     degrades to wall clock (restarts are seconds apart, so only a
-    backward step larger than the downtime could invert it)."""
+    backward step larger than the downtime could invert it).
+
+    ``namespace`` (the coordinator pool — docs/CLUSTER.md): a pooled
+    coordinator prefixes its ring member id (``"c1."``), because
+    issue-order comparison is only meaningful WITHIN one coordinator's
+    id stream — two pool members' clocks and epochs are unrelated, so
+    a worker receiving rounds from both must never let one member's id
+    fence the other's (nodes/worker.py ``_rid_split``).  Empty (single
+    coordinator) keeps the id byte-identical to every earlier version.
+    """
     with _round_id_lock:
         ns = max(time.time_ns(), _last_round_ns[0] + 1)
         _last_round_ns[0] = ns
-    return f"{epoch:08x}{ns:016x}"
+    rid = f"{epoch:08x}{ns:016x}"
+    return f"{namespace}.{rid}" if namespace else rid
 
 
 class WorkerRef:
@@ -303,6 +315,20 @@ class CoordRPCHandler:
         self._slow_trigger = SlowRequestTrigger(
             threshold_s=forensics_slow_s, p99_factor=forensics_p99x,
         )
+        # coordinator pool membership (distpow_tpu/cluster/,
+        # docs/CLUSTER.md): None = single coordinator, every code path
+        # byte-identical to before.  Installed by set_cluster(); the
+        # Mine handler then redirects misrouted keys (NOT_OWNER), round
+        # ids gain this member's namespace, and Mine/Found frames carry
+        # the reply-to address shared workers route Results back on.
+        self.cluster: Optional[ClusterState] = None
+        #: this coordinator's WORKER-facing address, stamped into
+        #: cluster-mode Mine/Found params as ``coord_addr`` (set by
+        #: Coordinator.initialize_rpcs once the listener is bound)
+        self.reply_addr: str = ""
+
+    def set_cluster(self, state: ClusterState) -> None:
+        self.cluster = state
 
     # -- task table (coordinator.go:370-388) -------------------------------
     def _task_set(self, key: TaskKey, rid: str, q: "queue.Queue") -> None:
@@ -484,6 +510,28 @@ class CoordRPCHandler:
         # against another hash would fail verification.  None/"" keeps
         # every frame and every code path identical to plain traffic.
         model = params.get("hash_model") or None
+        cl = self.cluster
+        if cl is not None and not cl.owns(nonce):
+            if params.get("no_redirect"):
+                # a hedged sibling retry or a failover send (powlib,
+                # docs/CLUSTER.md): serve the foreign key — the shared
+                # worker fleet makes it correct; only the dominance
+                # cache's locality pays
+                metrics.inc("cluster.foreign_mines")
+            else:
+                # misrouted (stale client ring): the typed redirect
+                # carries a fresh snapshot so the client re-routes in
+                # one round trip.  Deliberately BEFORE the token is
+                # received: the redirecting coordinator is not serving
+                # this request, so it must not inject CoordinatorMine
+                # into the trace the real owner will complete.
+                owner = cl.ring.owner(nonce)
+                metrics.inc("cluster.not_owner_redirects")
+                RECORDER.record("cluster.not_owner", nonce=nonce.hex(),
+                                ntz=ntz, owner=owner,
+                                self_id=cl.self_id)
+                info["outcome"] = "not_owner"
+                raise NotOwnerError(owner, cl.ring.to_wire())
         trace = self.tracer.receive_token(decode_token(params["token"]))
         trace.record_action(
             act.CoordinatorMine(nonce=nonce, num_trailing_zeros=ntz)
@@ -652,6 +700,13 @@ class CoordRPCHandler:
             # off-default model rides only when requested: default
             # rounds stay wire-identical to every earlier version
             out["hash_model"] = model
+        if self.cluster is not None and self.reply_addr:
+            # pooled rounds carry their owner's worker-facing address:
+            # a SHARED worker routes this round's Results back to the
+            # coordinator that fanned it out, not its config default
+            # (docs/CLUSTER.md).  Absent outside cluster mode — single-
+            # coordinator frames stay wire-identical.
+            out["coord_addr"] = self.reply_addr
         if plan is not None:
             # capability-weighted rounds carry the shard's explicit
             # (tb_lo, tb_count) byte range; equal-weight rounds attach
@@ -673,6 +728,9 @@ class CoordRPCHandler:
         }
         if model:
             out["hash_model"] = model
+        if self.cluster is not None and self.reply_addr:
+            # the Found's cache-update-only ACK must route home too
+            out["coord_addr"] = self.reply_addr
         return out
 
     def _mine_send_failure(self, w: WorkerRef, shard: int, rid: str,
@@ -858,7 +916,10 @@ class CoordRPCHandler:
         # maxsize that ever blocked the Result dispatch thread would
         # wedge the whole round instead
         results: "queue.Queue" = queue.Queue()
-        rid = new_round_id(self.restart_epoch)
+        rid = new_round_id(
+            self.restart_epoch,
+            self.cluster.self_id if self.cluster is not None else "",
+        )
         self._task_set(key, rid, results)
         reassign = self.failure_policy == "reassign"
         probe_t = self.failure_probe_secs if reassign else None
@@ -1320,6 +1381,12 @@ class CoordRPCHandler:
         snap["active_tasks"] = len(self._tasks)
         snap["cache_entries"] = len(self.result_cache)
         snap["failure_policy"] = self.failure_policy
+        if self.cluster is not None:
+            # pool membership view (docs/CLUSTER.md): which shard this
+            # is and the ring it routes by — what `stats --discover`
+            # walks to cover the whole pool
+            snap["cluster"] = {"self": self.cluster.self_id,
+                               "ring": self.cluster.ring.to_wire()}
         snap["sched"] = {
             "max_inflight": self._sched_max_inflight,
             "coalesce": self._coalescer is not None,
@@ -1343,8 +1410,18 @@ class Coordinator:
                 ),
                 dump_dir=tdir,
             )
+        # pooled coordinators trace under DISTINCT identities: two
+        # processes sharing one vector-clock stream would interleave
+        # its components and trip every monotonicity invariant
+        # trace_check holds (docs/CLUSTER.md).  Shard 0 — and every
+        # single-coordinator config — keeps the historical
+        # "coordinator", so golden traces stay byte-identical.
+        shard = int(getattr(config, "ClusterSelf", -1))
+        identity = (f"coordinator{shard}"
+                    if getattr(config, "ClusterPeers", None) and shard > 0
+                    else "coordinator")
         self.tracer = make_tracer(
-            "coordinator", config.TracerServerAddr, config.TracerSecret,
+            identity, config.TracerServerAddr, config.TracerSecret,
             sink=sink,
         )
         self.handler = CoordRPCHandler(
@@ -1382,6 +1459,30 @@ class Coordinator:
         self.server.register("Node", StatsOnly(self.handler))
         self.client_addr: Optional[str] = None
         self.worker_addr: Optional[str] = None
+        # coordinator pool (distpow_tpu/cluster/, docs/CLUSTER.md):
+        # config-driven membership installs here; ':0'-bound harnesses
+        # call set_cluster_peers() once the real addresses exist
+        peers = list(getattr(config, "ClusterPeers", []) or [])
+        if peers:
+            self.set_cluster_peers(
+                peers, int(getattr(config, "ClusterSelf", -1)))
+
+    def set_cluster_peers(self, peers: List[str], self_index: int) -> None:
+        """Join (or rewire) the coordinator pool: build the canonical
+        ring from the peer list, adopt member id ``c<self_index>``,
+        register the ``Cluster`` RPC service, and advertise the ring in
+        every ``rpc.hello`` ack.  Call before the first Mine; harnesses
+        binding on ':0' call it after ``initialize_rpcs`` when the real
+        peer addresses exist (the set_worker_addrs discipline)."""
+        if not (0 <= self_index < len(peers)):
+            raise ValueError(
+                f"ClusterSelf={self_index} is not an index into the "
+                f"{len(peers)}-entry ClusterPeers list"
+            )
+        state = ClusterState(ring_from_peers(peers), f"c{self_index}")
+        self.handler.set_cluster(state)
+        self.server.register("Cluster", ClusterService(state))
+        self.server.hello_extra = state.hello_extra
 
     def set_worker_addrs(self, addrs: List[str]) -> None:
         """Rebind worker addresses after construction.
@@ -1406,6 +1507,9 @@ class Coordinator:
         """Bind the segregated worker-facing and client-facing listeners."""
         self.worker_addr = self.server.listen(self.config.WorkerAPIListenAddr)
         self.client_addr = self.server.listen(self.config.ClientAPIListenAddr)
+        # cluster-mode rounds stamp this as their reply-to so shared
+        # workers deliver Results to the round's owner (docs/CLUSTER.md)
+        self.handler.reply_addr = self.worker_addr
         self.server.serve_in_background()
         log.info(
             "coordinator serving clients on %s, workers on %s",

@@ -97,6 +97,21 @@ def _backend_model_name(backend) -> str:
     return getattr(backend, "hash_model", "md5")
 
 
+def _rid_split(rid: str) -> Tuple[str, str]:
+    """``(namespace, ordering_body)`` of a round id.
+
+    Pooled coordinators prefix their ring member id
+    (``"c1.<epoch><ns>"`` — nodes/coordinator.py new_round_id): the
+    issue-order comparison zombie fencing relies on is only meaningful
+    WITHIN one coordinator's id stream, so the namespace must be
+    split off before any ordering.  Single-coordinator ids have no
+    separator and land in the ``""`` namespace — every pre-cluster id
+    keeps exactly its old ordering behavior.
+    """
+    ns, sep, body = rid.rpartition(".")
+    return (ns, body) if sep else ("", rid)
+
+
 def _rid_order(rid: str) -> str:
     """Round-id ordering key, robust to the id-format width change.
 
@@ -107,9 +122,10 @@ def _rid_order(rid: str) -> str:
     Left-padding with zeros makes the two formats compare correctly
     during a mixed-format window (worker outlives a coordinator
     upgrade); plain string comparison would order EVERY new-format id
-    before every old-format one.
+    before every old-format one.  Callers comparing ids must first
+    establish they share a namespace (``_rid_split``).
     """
-    return rid.rjust(24, "0")
+    return _rid_split(rid)[1].rjust(24, "0")
 
 
 class TaskRound:
@@ -127,14 +143,20 @@ class TaskRound:
     coordinator.py module docstring); it is echoed in every result this
     round sends so the coordinator can drop whatever a zombie does leak
     through the unavoidable check-then-send window.
+
+    ``reply_to`` (coordinator pool, docs/CLUSTER.md): the worker-facing
+    address of the coordinator that fanned this round out — shared
+    workers route the round's Results back on it instead of the config
+    default.  None outside cluster mode.
     """
 
-    __slots__ = ("ev", "superseded", "round_id")
+    __slots__ = ("ev", "superseded", "round_id", "reply_to")
 
-    def __init__(self, round_id=None):
+    def __init__(self, round_id=None, reply_to=None):
         self.ev = threading.Event()
         self.superseded = False
         self.round_id = round_id
+        self.reply_to = reply_to
 
 
 class WorkerRPCHandler:
@@ -207,6 +229,14 @@ class WorkerRPCHandler:
           the live miner stops on its own via the cache-aware cancel
           check, delivering the installed secret as its (current-round)
           result.
+        * Found from a DIFFERENT round-id namespace (two pool members
+          fanning to this shared worker — docs/CLUSTER.md): the two
+          coordinators' clocks and epochs are unrelated, so neither
+          "newer" verdict is sound.  Treated like "older": the live
+          round is untouched (its own coordinator owes it a matching
+          Found), the foreign Found is cache-update-only, and the live
+          miner stops via the cache-aware cancel check if the installed
+          secret satisfies it.
         """
         with self._tasks_lock:
             cur = self._tasks.get(key)
@@ -216,6 +246,8 @@ class WorkerRPCHandler:
                 del self._tasks[key]
                 metrics.gauge("worker.mine_queue_depth", len(self._tasks))
                 return cur
+            if _rid_split(rid)[0] != _rid_split(cur.round_id)[0]:
+                return None
             if _rid_order(rid) > _rid_order(cur.round_id):
                 del self._tasks[key]
                 metrics.gauge("worker.mine_queue_depth", len(self._tasks))
@@ -284,7 +316,8 @@ class WorkerRPCHandler:
                     f"tb_count={tb_count}"
                 )
             tb_range = (tb_lo, tb_count)
-        round_ = TaskRound(params.get("round"))
+        round_ = TaskRound(params.get("round"),
+                           reply_to=params.get("coord_addr") or None)
         self._task_set(key, round_)
 
         trace = self.tracer.receive_token(decode_token(params["token"]))
@@ -327,7 +360,8 @@ class WorkerRPCHandler:
             )
             if cacheable:
                 self.result_cache.add(key[0], key[1], secret, trace)
-            self._send_result(key, None, trace, params.get("round"))
+            self._send_result(key, None, trace, params.get("round"),
+                              reply_to=params.get("coord_addr") or None)
         return {}
 
     def Cancel(self, params) -> dict:
@@ -359,7 +393,8 @@ class WorkerRPCHandler:
 
     # -- miner (worker.go:258-401) -----------------------------------------
     def _send_result(self, key: TaskKey, secret: Optional[bytes], trace,
-                     round_id=None, hash_model: Optional[str] = None) -> None:
+                     round_id=None, hash_model: Optional[str] = None,
+                     reply_to: Optional[str] = None) -> None:
         metrics.inc("worker.results_sent")
         msg = {
             # bytes fields travel raw: wire v2 ships them verbatim,
@@ -379,6 +414,11 @@ class WorkerRPCHandler:
             # Absent for default-model results, keeping those frames
             # wire-identical to every earlier version on both codecs.
             msg["hash_model"] = hash_model
+        if reply_to is not None:
+            # pooled round (docs/CLUSTER.md): the forwarder pops this
+            # and delivers to the round's OWN coordinator — the key
+            # never reaches the wire, so Result frames stay identical
+            msg["coord_addr"] = reply_to
         self.result_queue.put(msg)
         # forwarder backlog: grows when the coordinator is slow/away
         # (qsize is advisory under concurrency — a gauge, not a ledger)
@@ -394,7 +434,7 @@ class WorkerRPCHandler:
             )
         )
         self._send_result(key, secret, trace, round_.round_id,
-                          hash_model=hash_model)
+                          hash_model=hash_model, reply_to=round_.reply_to)
         round_.ev.wait()  # coordinator always sends Found (worker.go:375-379)
         if round_.superseded:
             # replaced by a newer Mine for this key while waiting: the
@@ -405,7 +445,8 @@ class WorkerRPCHandler:
                 nonce=key[0], num_trailing_zeros=key[1], worker_byte=key[2]
             )
         )
-        self._send_result(key, None, trace, round_.round_id)
+        self._send_result(key, None, trace, round_.round_id,
+                          reply_to=round_.reply_to)
 
     def _mine(self, key: TaskKey, worker_bits: int, round_: TaskRound,
               trace, hash_model=None, tb_range=None) -> None:
@@ -540,8 +581,10 @@ class WorkerRPCHandler:
                 nonce=nonce, num_trailing_zeros=ntz, worker_byte=worker_byte
             )
         )
-        self._send_result(key, None, trace, round_.round_id)
-        self._send_result(key, None, trace, round_.round_id)
+        self._send_result(key, None, trace, round_.round_id,
+                          reply_to=round_.reply_to)
+        self._send_result(key, None, trace, round_.round_id,
+                          reply_to=round_.reply_to)
 
 
 class Worker:
@@ -620,6 +663,11 @@ class Worker:
         self.server.register("Node", StatsOnly(self.handler))
         self.bound_addr: Optional[str] = None
         self._forwarder: Optional[threading.Thread] = None
+        # per-destination delivery queues for pooled rounds
+        # (docs/CLUSTER.md): keyed by the round's stamped reply-to
+        # address ("" = the config default); the forwarder demux
+        # creates entries, delivery loops drain them
+        self._forward_subqueues: Dict[str, "queue.Queue"] = {}
         self._stopping = threading.Event()
         # elastic membership (distpow_tpu/fleet/, docs/FLEET.md):
         # opt-in — a FleetRegister=false worker is a static config
@@ -728,6 +776,16 @@ class Worker:
         — a restarted coordinator receives the result, installs it in
         its (journal-backed) cache, and a client retry completes from
         that cache (VERDICT r1 weak #5).
+
+        Coordinator pool (docs/CLUSTER.md): pooled rounds stamp their
+        owner's worker-facing address as ``coord_addr``, and delivery
+        runs PER DESTINATION — one delivery loop per coordinator, fed
+        by a demux of the shared result queue — so a dead pool member's
+        retry backoff can never head-of-line-block results owed to a
+        live one (messages to the dead member park on ITS loop alone
+        and flow the moment it restarts).  Single-coordinator workers
+        see exactly one destination and keep the historical per-message
+        behavior.
         """
 
         def _result_trace_id(res) -> int:
@@ -740,13 +798,34 @@ class Worker:
             except (ValueError, KeyError, TypeError):
                 return 0
 
-        def forward():
+        def _backlog() -> int:
+            # total undelivered results across demux + every
+            # destination: the signal the gauge existed for ("grows
+            # when the coordinator is slow/away").  The values are
+            # SNAPSHOTTED: delivery threads call this while the demux
+            # may be inserting a new destination, and iterating the
+            # live dict would RuntimeError the delivery thread dead
+            # mid-message (review PR 10)
+            return self.result_queue.qsize() + sum(
+                q.qsize() for q in list(self._forward_subqueues.values()))
+
+        def delivery_loop(src: "queue.Queue", addr: str) -> None:
+            """Deliver ``src``'s messages in order to one destination.
+            ``addr`` empty = the config-default coordinator (whose
+            connection object doubles as the protocol client and is
+            re-dialed in place); otherwise a pool member dialed
+            lazily."""
             backoff = 0.2
+            extra: Optional[RPCClient] = None
             while True:
-                res = self.result_queue.get()
-                metrics.gauge("worker.forward_queue_depth",
-                              self.result_queue.qsize())
+                res = src.get()
+                metrics.gauge("worker.forward_queue_depth", _backlog())
                 if res is None:
+                    if extra is not None:
+                        try:
+                            extra.close()
+                        except OSError:
+                            pass
                     return
                 tid = _result_trace_id(res) if SPANS.enabled else 0
                 # the delivery clock starts ONCE per message, outside
@@ -760,7 +839,13 @@ class Worker:
                 while not self._stopping.is_set():
                     try:
                         attempts += 1
-                        self.coordinator.go(
+                        if addr:
+                            if extra is None:
+                                extra = RPCClient(addr)
+                            client = extra
+                        else:
+                            client = self.coordinator
+                        client.go(
                             "CoordRPCHandler.Result", res
                         ).result(timeout=10.0)
                         if tid:
@@ -785,25 +870,70 @@ class Worker:
                         RECORDER.record(
                             "worker.forward_retry",
                             worker=self.config.WorkerID,
-                            queue_depth=self.result_queue.qsize(),
+                            queue_depth=_backlog(),
                             error=str(exc),
                         )
                         log.warning(
-                            "%s: result delivery failed (%s); re-dialing "
-                            "coordinator in %.1fs",
-                            self.config.WorkerID, exc, backoff,
+                            "%s: result delivery to %s failed (%s); "
+                            "re-dialing in %.1fs",
+                            self.config.WorkerID,
+                            addr or self.config.CoordAddr, exc, backoff,
                         )
                         if self._stopping.wait(backoff):
                             return
                         backoff = min(backoff * 2, 5.0)
-                        try:
-                            self.coordinator.close()
-                        except OSError:
-                            pass
-                        try:
-                            self.coordinator = RPCClient(self.config.CoordAddr)
-                        except OSError:
-                            continue
+                        # tear down exactly the connection that failed;
+                        # other destinations' loops are independent
+                        if addr:
+                            if extra is not None:
+                                try:
+                                    extra.close()
+                                except OSError:
+                                    pass
+                                extra = None
+                        else:
+                            try:
+                                self.coordinator.close()
+                            except OSError:
+                                pass
+                            try:
+                                self.coordinator = RPCClient(
+                                    self.config.CoordAddr)
+                            except OSError:
+                                continue
+
+        def destination(addr: str) -> "queue.Queue":
+            q = self._forward_subqueues.get(addr)
+            if q is None:
+                # distpow: ok bounded-queue -- protocol-bounded like
+                # the result queue it demuxes: depth is the in-flight
+                # rounds x2 owed to ONE coordinator, every message is
+                # owed to that coordinator's ack ledger (dropping one
+                # wedges its round), and the backlog is observable via
+                # worker.forward_queue_depth
+                q = self._forward_subqueues[addr] = queue.Queue()
+                threading.Thread(
+                    target=delivery_loop, args=(q, addr), daemon=True,
+                    name=f"forward-{addr or 'default'}",
+                ).start()
+            return q
+
+        def forward():
+            # demux only — never blocks on a destination, so one dead
+            # pool member cannot stall the others' deliveries
+            while True:
+                res = self.result_queue.get()
+                if res is None:
+                    for q in list(self._forward_subqueues.values()):
+                        q.put(None)
+                    return
+                # pooled rounds stamp their owner's address; popped
+                # HERE so the Result frame on the wire stays identical
+                reply_to = res.pop("coord_addr", None) or ""
+                if reply_to == self.config.CoordAddr:
+                    reply_to = ""
+                destination(reply_to).put(res)
+                metrics.gauge("worker.forward_queue_depth", _backlog())
 
         self._forwarder = threading.Thread(target=forward, daemon=True)
         self._forwarder.start()

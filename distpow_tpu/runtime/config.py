@@ -69,6 +69,13 @@ class ClientConfig:
     # so only chaos/ops configs that must detect silently-dropped frames
     # should set this).
     MineAttemptTimeoutS: float = 0.0
+    # --- coordinator pool (distpow_tpu/cluster/, docs/CLUSTER.md) --------
+    # Client-facing addresses of the WHOLE coordinator pool, in shard
+    # order — the ring seeds.  Non-empty with >= 2 entries flips powlib
+    # into cluster mode: consistent-hash owner routing, hedged sibling
+    # retry on RETRY_AFTER, ring-guided failover.  Empty (default)
+    # keeps the single-coordinator behavior byte-identical.
+    CoordAddrs: List[str] = field(default_factory=list)
     # Deterministic fault-injection plan (runtime/faults.py); empty = no
     # injection.  Also reachable via $DISTPOW_FAULTS and --faults.
     FaultPlanFile: str = ""
@@ -147,6 +154,16 @@ class CoordinatorConfig:
     # fixed budget is generous.  0 = off.  Both arms off (the default)
     # disables the trigger entirely.
     ForensicsSlowP99X: float = 0.0
+    # --- coordinator pool (distpow_tpu/cluster/, docs/CLUSTER.md) --------
+    # Client-facing addresses of the whole pool in shard order (this
+    # coordinator's own entry included) — the consistent-hash ring is a
+    # pure function of this list, so every member and every client
+    # computes the identical ring.  Empty (default) = single
+    # coordinator, byte-identical to every earlier version.
+    ClusterPeers: List[str] = field(default_factory=list)
+    # This coordinator's index into ClusterPeers (its ring member id is
+    # "c<index>").  Required (>= 0) when ClusterPeers is set.
+    ClusterSelf: int = -1
 
 
 @dataclass

@@ -94,6 +94,20 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
 * ``forensics.fetches`` / ``forensics.fetch_failures`` — fleet-wide
   span sweeps issued and per-node Spans polls that failed or missed
   the shared deadline (distpow_tpu/obs/forensics.py)
+* ``cluster.not_owner_redirects`` — misrouted Mines a pooled
+  coordinator answered with the typed NOT_OWNER redirect + ring
+  snapshot (distpow_tpu/cluster/, docs/CLUSTER.md)
+* ``cluster.foreign_mines`` — Mines a pooled coordinator served for a
+  key it does NOT own (``no_redirect`` hedged/failover sends — the
+  shared worker fleet makes them correct, only cache locality pays)
+* ``cluster.ring_serves`` — ``Cluster.Ring`` snapshot requests served
+* ``cluster.reroutes`` — powlib mines re-routed to a different shard
+  after adopting a NOT_OWNER redirect's ring snapshot
+* ``cluster.failovers`` — powlib mines failed over to a ring sibling
+  after the owner shard's transport died and its re-dial failed
+* ``cluster.sibling_hedges`` — RETRY_AFTER rejections hedged to the
+  next ring sibling instead of waiting out the owner's hint
+  (non-counting, like every server-paced retry)
 
 Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 ``KNOWN_HISTOGRAM_PREFIXES`` vs ``observe()``/``time()`` call sites):
@@ -111,6 +125,9 @@ Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 * ``fleet.heartbeat_rtt_s`` — worker-observed lease-heartbeat round
   trip (distpow_tpu/fleet/agent.py; the cadence side lives in the
   registry's per-lease EMA and drives the hedge threshold)
+* ``cluster.failover_s`` — first owner-shard transport failure to the
+  successful reply from another shard: the client-observed cost of
+  riding out a coordinator death (nodes/powlib.py, docs/CLUSTER.md)
 * ``worker.time_to_cancel_s`` — Mine receipt to honored cancellation
 * ``search.launch_s``  — time blocked fetching one launch's result
   (the serial driver's FIFO drain; parallel/search.py)
@@ -177,6 +194,9 @@ KNOWN_COUNTERS = frozenset({
     "spans.dropped",
     "forensics.slow_captures",
     "forensics.fetches", "forensics.fetch_failures",
+    "cluster.not_owner_redirects", "cluster.foreign_mines",
+    "cluster.ring_serves",
+    "cluster.reroutes", "cluster.failovers", "cluster.sibling_hedges",
 })
 
 # Families minted from runtime values (f-string call sites): the
@@ -198,6 +218,7 @@ KNOWN_HISTOGRAMS = frozenset({
     "rpc.frame.sent_bytes", "rpc.frame.recv_bytes",
     "obs.sweep_s",
     "fleet.heartbeat_rtt_s",
+    "cluster.failover_s",
 })
 
 # Per-method families (runtime/rpc.py mints one histogram per

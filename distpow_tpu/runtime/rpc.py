@@ -83,6 +83,23 @@ class RPCRetryAfter(RPCError):
         self.delay_s = float(delay_s)
 
 
+class RPCNotOwner(RPCError):
+    """The remote coordinator REJECTED the call because the cluster
+    ring maps the key to a different shard (the scale-out plane's
+    typed redirect — distpow_tpu/cluster/, docs/CLUSTER.md).  The
+    ``ring`` attribute is the coordinator's fresh ring snapshot
+    (``HashRing.to_wire`` dict), carried in the response frame's
+    dedicated ``ring`` field: the client adopts it and re-routes in one
+    round trip, with no separate discovery call.  A fourth retry class:
+    like RETRY_AFTER it is worth re-issuing (elsewhere) and must not
+    burn the transport retry budget — the connection is healthy and the
+    server did exactly its job."""
+
+    def __init__(self, message: str, ring: dict):
+        super().__init__(message)
+        self.ring = dict(ring or {})
+
+
 #: pseudo-method of the per-connection codec negotiation exchange.  The
 #: hello rides an ordinary v1 frame so a JSON-only peer handles it as a
 #: normal (unknown-method) request; it is NOT passed through the fault
@@ -279,6 +296,12 @@ class RPCServer:
     def __init__(self, negotiate: Optional[bool] = None):
         self._negotiate = (SERVER_NEGOTIATE_DEFAULT
                            if negotiate is None else bool(negotiate))
+        #: optional callable returning extra keys merged into the
+        #: ``rpc.hello`` ack result beside ``codec`` (the cluster
+        #: plane's ring advertisement — docs/CLUSTER.md).  The ack is
+        #: always plain JSON, so the payload must be JSON-encodable;
+        #: pre-cluster clients ignore keys they don't know.
+        self.hello_extra = None
         self._services: Dict[str, object] = {}
         self._listeners = []
         self._threads = []
@@ -390,8 +413,18 @@ class RPCServer:
         want = req.get("params") or {}
         version = want.get("codec") if isinstance(want, dict) else None
         if version == wire.WIRE_VERSION:
-            resp = {"id": req.get("id"),
-                    "result": {"codec": wire.WIRE_VERSION}, "error": None}
+            result = {"codec": wire.WIRE_VERSION}
+            if self.hello_extra is not None:
+                try:
+                    result.update(self.hello_extra() or {})
+                # distpow: ok silent-except -- the hello extra is an
+                # ADVISORY advertisement (the cluster ring): a broken
+                # provider must not take codec negotiation down with
+                # it, and clients refresh the same payload via
+                # Cluster.Ring where a failure IS surfaced
+                except Exception:
+                    pass
+            resp = {"id": req.get("id"), "result": result, "error": None}
         else:
             resp = {"id": req.get("id"), "result": None,
                     "error": f"RPCError: unsupported wire codec {version!r}"}
@@ -443,6 +476,14 @@ class RPCServer:
                     resp["retry_after"] = float(retry_after)
                 except (TypeError, ValueError):
                     pass
+            # typed NOT_OWNER redirect: an exception carrying a
+            # ``ring_wire`` snapshot (duck-typed — this layer must not
+            # import cluster, mirroring the retry_after discipline)
+            # ships it as the response frame's dedicated ``ring``
+            # field, so misrouted clients re-route in one round trip
+            ring = getattr(exc, "ring_wire", None)
+            if isinstance(ring, dict):
+                resp["ring"] = ring
         if faults.PLAN is not None:
             hit = faults.PLAN.on_frame(
                 "server", str(req.get("method") or ""), peer
@@ -551,6 +592,10 @@ class RPCClient:
         self._next_id = 0
         self._closed = False
         self._dead: Optional[RPCError] = None  # set by the reader on death
+        #: extra keys a v2 server's hello ack carried beside ``codec``
+        #: (the cluster ring advertisement — docs/CLUSTER.md); empty on
+        #: JSON-pinned clients and against pre-extension servers
+        self.hello_info: Dict[str, Any] = {}
         # wire codec (module docstring): negotiated synchronously BEFORE
         # the reader thread exists, so reader and senders always agree
         mode = codec or CLIENT_CODEC_DEFAULT
@@ -609,6 +654,8 @@ class RPCClient:
               and resp["result"].get("codec") == wire.WIRE_VERSION)
         if ok:
             metrics.inc("rpc.codec.negotiated_v2")
+            self.hello_info = {k: v for k, v in resp["result"].items()
+                               if k != "codec"}
             return BINARY_CODEC
         metrics.inc("rpc.codec.fallback_v1")
         if mode == "binary":
@@ -651,7 +698,15 @@ class RPCClient:
                         retry_after = float(resp["retry_after"])
                     except (KeyError, TypeError, ValueError):
                         retry_after = None
-                    if retry_after is not None:
+                    ring = resp.get("ring")
+                    if isinstance(ring, dict):
+                        # NOT_OWNER redirect (cluster plane): the ring
+                        # snapshot outranks a retry_after hint — a
+                        # misrouted key must move, not wait
+                        fut.set_exception(RPCNotOwner(
+                            resp["error"], ring
+                        ))
+                    elif retry_after is not None:
                         fut.set_exception(RPCRetryAfter(
                             resp["error"], retry_after
                         ))

@@ -17,12 +17,17 @@ unchanged.
 Frame payloads (everything after the 4-byte length prefix)::
 
     request  := 0x01 | varint id | method | value(params)
-    response := 0x02 | varint id | u8 flags | [f64 retry_after] | value
+    response := 0x02 | varint id | u8 flags | [f64 retry_after]
+                | [value ring] | value
     flags    := bit0 error (value is the error string)
                 bit1 retry_after present (sched/admission.py typed
                      backpressure — the hint is a dedicated header
                      field, exactly like the JSON frame's dedicated
                      ``retry_after`` key)
+                bit2 ring present (the cluster plane's NOT_OWNER
+                     redirect ships a ring snapshot dict —
+                     docs/CLUSTER.md; only pooled coordinators ever
+                     set it, so pre-cluster traffic is bit-identical)
 
     method   := 0x80|idx            interned (METHODS table)
               | 0x00 varint len utf8  anything else
@@ -80,6 +85,10 @@ METHODS: Tuple[str, ...] = (
     # table stays append-only
     "Node.Stats",
     "Node.Spans",
+    # appended for the coordinator scale-out plane (distpow_tpu/cluster/,
+    # docs/CLUSTER.md): the ring snapshot on demand; table stays
+    # append-only
+    "Cluster.Ring",
 )
 _METHOD_IDS = {m: i for i, m in enumerate(METHODS)}
 
@@ -114,6 +123,19 @@ KEYS: Tuple[str, ...] = (
     "dur_s",
     "attrs",
     "seq",
+    # appended for the coordinator scale-out plane (distpow_tpu/cluster/,
+    # docs/CLUSTER.md): ring snapshots (Cluster.Ring / NOT_OWNER
+    # redirects / the extended rpc.hello ack), the Mine reply-to addr a
+    # pooled coordinator stamps so shared workers route each Result
+    # back to its round's owner, and the no-redirect marker on hedged/
+    # failover sends; table stays append-only
+    "ring",
+    "version",
+    "vnodes",
+    "members",
+    "coord_addr",
+    "no_redirect",
+    "self",
 )
 _KEY_IDS = {k: i for i, k in enumerate(KEYS)}
 
@@ -121,6 +143,12 @@ FRAME_REQUEST = 0x01
 FRAME_RESPONSE = 0x02
 FLAG_ERROR = 0x01
 FLAG_RETRY_AFTER = 0x02
+#: error frame carries a ring snapshot (the cluster plane's NOT_OWNER
+#: redirect — docs/CLUSTER.md).  Only a POOLED coordinator ever sets
+#: it, so single-coordinator deployments stay byte-identical to every
+#: earlier version of this codec; a pre-cluster peer never receives the
+#: flag because it never dials a pool.
+FLAG_RING = 0x04
 
 _TAG_NONE = 0x00
 _TAG_FALSE = 0x01
@@ -306,11 +334,15 @@ def encode_frame(obj: dict) -> bytes:
         _put_varint(out, rid)
         error = obj.get("error")
         retry_after = obj.get("retry_after")
+        ring = obj.get("ring")
         flags = (FLAG_ERROR if error else 0) | \
-            (FLAG_RETRY_AFTER if retry_after is not None else 0)
+            (FLAG_RETRY_AFTER if retry_after is not None else 0) | \
+            (FLAG_RING if ring is not None else 0)
         out.append(bytes((flags,)))
         if retry_after is not None:
             out.append(struct.pack(">d", float(retry_after)))
+        if ring is not None:
+            _encode_value(out, ring)
         _encode_value(out, str(error) if error else obj.get("result"))
     return b"".join(out)
 
@@ -339,11 +371,16 @@ def decode_frame(data: bytes) -> dict:
         obj = {"id": rid, "method": method, "params": params}
     elif kind == FRAME_RESPONSE:
         flags = cur.u8()
-        if flags & ~(FLAG_ERROR | FLAG_RETRY_AFTER):
+        if flags & ~(FLAG_ERROR | FLAG_RETRY_AFTER | FLAG_RING):
             raise ValueError(f"unknown response flags 0x{flags:02x}")
         retry_after = None
         if flags & FLAG_RETRY_AFTER:
             retry_after = struct.unpack(">d", cur.take(8))[0]
+        ring = None
+        if flags & FLAG_RING:
+            ring = _decode_value(cur)
+            if not isinstance(ring, dict):
+                raise ValueError("ring frame field must decode to a dict")
         body = _decode_value(cur)
         if flags & FLAG_ERROR:
             if not isinstance(body, str):
@@ -353,6 +390,8 @@ def decode_frame(data: bytes) -> dict:
             obj = {"id": rid, "result": body, "error": None}
         if retry_after is not None:
             obj["retry_after"] = retry_after
+        if ring is not None:
+            obj["ring"] = ring
     else:
         raise ValueError(f"unknown frame kind 0x{kind:02x}")
     if not cur.done():

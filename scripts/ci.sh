@@ -41,6 +41,12 @@
 # ranges and solves, a frozen straggler's shard is hedged, `stats
 # --discover`'s membership pull tracks the fleet, and a drain releases
 # only after its in-flight rounds finish — ~20 s, CPU, no jax.
+# `--cluster-smoke` runs the coordinator-pool chaos smoke
+# (scripts/cluster_smoke.py, docs/CLUSTER.md): a REAL 2-process
+# coordinator pool over one shared worker fleet, discovery expanding
+# one seed to the whole pool, then one shard SIGKILLed mid-load —
+# zero client-visible Mine errors via ring failover, and trace_check
+# must still report 0 violations — ~20 s, CPU, no jax.
 # `--forensics-smoke` runs the request-forensics smoke
 # (scripts/forensics_smoke.py, docs/FORENSICS.md): a REAL 3-process
 # cluster (coordinator + 2 workers, one delayed by the PR 1 fault
@@ -48,7 +54,7 @@
 # Node.Spans sweep must stitch a timeline naming the delayed worker's
 # shard; trace_check must still report 0 violations — ~15 s, CPU,
 # no jax.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke]
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -111,6 +117,13 @@ if [ "${1:-}" = "--fleet-smoke" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "--cluster-smoke" ]; then
+  echo "=== cluster smoke (2-process coordinator pool + SIGKILL failover) ==="
+  JAX_PLATFORMS=cpu python scripts/cluster_smoke.py
+  echo "=== cluster smoke OK ==="
+  exit 0
+fi
+
 if [ "${1:-}" = "--forensics-smoke" ]; then
   echo "=== forensics smoke (3-process cluster + delayed worker + stitched timeline) ==="
   JAX_PLATFORMS=cpu python scripts/forensics_smoke.py
@@ -156,7 +169,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke]" >&2
           exit 2 ;;
 esac
 

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Deterministic coordinator-pool chaos smoke (docs/CLUSTER.md;
+ci.sh --cluster-smoke).
+
+The ISSUE 15 chaos acceptance, end to end, on a REAL multi-process
+pool — separate OS processes over localhost RPC, killed with a real
+SIGKILL, not an in-process shutdown():
+
+1. ``config_gen --coordinators 2`` emits the pool configs (ring seeds,
+   per-shard listen addrs, ONE shared worker list); boot tracing
+   server + BOTH coordinators + 2 python-backend workers as
+   subprocesses;
+2. ``stats --cluster --discover <shard0>`` must expand ONE seed to the
+   whole pool (the ring in the Stats snapshot) and dedup-merge both
+   members' Fleet.Members tables;
+3. this process's powlib (cluster mode via the generated client
+   config's CoordAddrs) drives a stream of Mines routed across both
+   shards; mid-stream, coordinator 1 is SIGKILLed;
+4. every Mine — including keys the dead shard owns, and the ones
+   in flight on it at kill time — must complete with ZERO
+   client-visible errors (ring failover + the shared worker fleet);
+   ``cluster.failovers`` must tick and ``cluster.failover_s`` must
+   record the ride-out cost;
+5. ``trace_check`` over the tracing server's logs must report
+   0 violations — the redirect/failover machinery is invisible to the
+   16-action trace vocabulary.
+
+Prints one JSON summary line on stdout (details to stderr); exits 0
+only when every gate held.  ~20 s, pure CPU, no jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distpow_tpu.cluster import ring_from_peers  # noqa: E402
+from distpow_tpu.nodes import Client  # noqa: E402
+from distpow_tpu.runtime.config import (  # noqa: E402
+    ClientConfig,
+    CoordinatorConfig,
+    read_json_config,
+)
+from distpow_tpu.runtime.metrics import REGISTRY as metrics  # noqa: E402
+from distpow_tpu.runtime.rpc import RPCClient  # noqa: E402
+
+NTZ = 1
+N_MINES = 16  # per phase (pre-kill, post-kill)
+
+
+def gate(name, ok, detail=""):
+    print(f"[cluster-smoke] {'PASS' if ok else 'FAIL'}: {name}"
+          f"{' — ' + detail if detail else ''}", file=sys.stderr)
+    if not ok:
+        sys.exit(1)
+
+
+def wait_rpc(addr: str, method: str, timeout_s: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            c = RPCClient(addr, timeout=1.0)
+            try:
+                c.call(method, {}, timeout=2.0)
+                return
+            finally:
+                c.close()
+        except Exception as exc:  # readiness probe: any failure retries
+            last = exc
+            time.sleep(0.1)
+    raise AssertionError(f"{addr} never answered {method}: {last}")
+
+
+def drain(notify, n, timeout_s=90.0):
+    got, errors = [], []
+    deadline = time.monotonic() + timeout_s
+    while len(got) < n and time.monotonic() < deadline:
+        try:
+            res = notify.get(timeout=0.5)
+        except Exception:
+            continue
+        got.append(res)
+        if res.error:
+            errors.append(str(res.error))
+    return got, errors
+
+
+def main() -> int:
+    # config_gen's port range overlaps the kernel's ephemeral range, so
+    # a randomly chosen port can collide with a live connection and
+    # kill a node at bind time — one full re-roll with fresh ports
+    # covers that without masking real boot failures
+    for attempt in (1, 2):
+        try:
+            return _run()
+        except AssertionError as exc:
+            if attempt == 2:
+                raise
+            print(f"[cluster-smoke] boot attempt {attempt} failed "
+                  f"({exc}); re-rolling ports", file=sys.stderr)
+    return 1
+
+
+def _run() -> int:
+    procs = {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+
+    def spawn(name, *argv):
+        p = subprocess.Popen(
+            [sys.executable, *argv], cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs[name] = p
+        return p
+
+    with tempfile.TemporaryDirectory() as td:
+        # NO fixed --seed: a fixed seed means fixed ports, and a
+        # leftover listener from an overlapping/killed earlier run
+        # would silently join (and contaminate) this cluster — the
+        # smoke's determinism lives in the protocol, not the ports
+        subprocess.run(
+            [sys.executable, "-m", "distpow_tpu.cli.config_gen",
+             "--config-dir", td, "--workers", "2", "--coordinators", "2"],
+            cwd=REPO, env=env, check=True, capture_output=True,
+        )
+        wcfg_path = os.path.join(td, "worker_config.json")
+        wcfg = json.loads(open(wcfg_path).read())
+        wcfg["Backend"] = "python"
+        open(wcfg_path, "w").write(json.dumps(wcfg))
+        ts_path = os.path.join(td, "tracing_server_config.json")
+        ts_cfg = json.loads(open(ts_path).read())
+        ts_cfg["OutputFile"] = os.path.join(td, "trace_output.log")
+        ts_cfg["ShivizOutputFile"] = os.path.join(td, "shiviz_output.log")
+        open(ts_path, "w").write(json.dumps(ts_cfg))
+        coord0 = read_json_config(
+            os.path.join(td, "coordinator_config.json"), CoordinatorConfig)
+        coord1 = read_json_config(
+            os.path.join(td, "coordinator1_config.json"), CoordinatorConfig)
+        client_cfg = read_json_config(
+            os.path.join(td, "client_config.json"), ClientConfig)
+        gate("config_gen emitted the pool",
+             coord0.ClusterPeers == coord1.ClusterPeers
+             and coord0.ClusterSelf == 0 and coord1.ClusterSelf == 1
+             and client_cfg.CoordAddrs == coord0.ClusterPeers
+             and coord0.Workers == coord1.Workers,
+             f"ring seeds {coord0.ClusterPeers}")
+
+        try:
+            spawn("tracer", "-m", "distpow_tpu.cli.tracing_server",
+                  "--config", ts_path)
+            time.sleep(0.5)
+            spawn("coord0", "-m", "distpow_tpu.cli.coordinator",
+                  "--config", os.path.join(td, "coordinator_config.json"))
+            spawn("coord1", "-m", "distpow_tpu.cli.coordinator",
+                  "--config", os.path.join(td, "coordinator1_config.json"))
+            for i, addr in enumerate(coord0.Workers):
+                spawn(f"worker{i + 1}", "-m", "distpow_tpu.cli.worker",
+                      "--config", wcfg_path, "--id", f"worker{i + 1}",
+                      "--listen", addr)
+            for addr in coord0.Workers:
+                wait_rpc(addr, "WorkerRPCHandler.Ping")
+            for addr in client_cfg.CoordAddrs:
+                wait_rpc(addr, "Node.Stats")
+            gate("real 2-coordinator pool up", True,
+                 f"shards at {client_cfg.CoordAddrs}")
+
+            # -- discovery: one seed covers the whole pool ------------
+            disc = subprocess.run(
+                [sys.executable, "-m", "distpow_tpu.cli.stats",
+                 "--cluster", "--discover", client_cfg.CoordAddrs[0]],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=60,
+            )
+            gate("discovery sweep exit 0", disc.returncode == 0,
+                 disc.stderr[-300:])
+            merged = json.loads(disc.stdout)
+            per_node = merged.get("per_node") or {}
+            covered = {m.get("addr") for m in per_node.values()}
+            want = set(client_cfg.CoordAddrs) | set(coord0.Workers)
+            gate("one seed expands to pool + shared fleet",
+                 want <= covered,
+                 f"{len(per_node)} nodes swept, want {sorted(want)}")
+
+            # -- cluster client over the REAL pool --------------------
+            client = Client(ClientConfig(
+                ClientID="csmoke",
+                CoordAddr=client_cfg.CoordAddr,
+                CoordAddrs=list(client_cfg.CoordAddrs),
+                TracerServerAddr=ts_cfg["ServerBind"],
+                ChCapacity=256,
+                MineRetries=8, MineBackoffS=0.05, MineBackoffMaxS=0.4,
+            ))
+            client.initialize()
+            ring = ring_from_peers(client_cfg.CoordAddrs)
+            try:
+                # phase 1: healthy pool serves keys on BOTH shards
+                nonces = [bytes([i, 21]) for i in range(N_MINES)]
+                owners = {ring.owner(x) for x in nonces}
+                gate("keyspace sample spans both shards",
+                     owners == {"c0", "c1"}, f"owners={sorted(owners)}")
+                for x in nonces:
+                    client.mine(x, NTZ)
+                got, errors = drain(client.notify_queue, len(nonces))
+                gate("healthy pool: all mines complete",
+                     len(got) == len(nonces) and not errors,
+                     f"{len(got)}/{len(nonces)}, errors={errors[:2]}")
+
+                # phase 2: SIGKILL shard c1 MID-LOAD — issue the next
+                # wave first so some mines are in flight on the victim
+                before_failovers = metrics.get("cluster.failovers")
+                wave = [bytes([i, 22]) for i in range(N_MINES)]
+                victim_keys = [x for x in wave if ring.owner(x) == "c1"]
+                gate("kill wave targets the victim shard too",
+                     len(victim_keys) >= 2, f"{len(victim_keys)} keys")
+                for x in wave[:len(wave) // 2]:
+                    client.mine(x, NTZ)
+                procs["coord1"].send_signal(signal.SIGKILL)
+                procs["coord1"].wait(timeout=10)
+                for x in wave[len(wave) // 2:]:
+                    client.mine(x, NTZ)
+                got, errors = drain(client.notify_queue, len(wave))
+                gate("SIGKILL mid-load: zero client-visible errors",
+                     len(got) == len(wave) and not errors,
+                     f"{len(got)}/{len(wave)} complete, "
+                     f"errors={errors[:2]}")
+                failovers = metrics.get("cluster.failovers") \
+                    - before_failovers
+                gate("ring failover engaged", failovers >= 1,
+                     f"{failovers} failover(s)")
+                hist = metrics.snapshot()["histograms"].get(
+                    "cluster.failover_s") or {}
+                gate("failover cost recorded",
+                     (hist.get("count") or 0) >= 1,
+                     f"count={hist.get('count')} "
+                     f"max={hist.get('max', 0):.3f}s")
+            finally:
+                client.close()
+
+            # -- tracing-plane invariants survived the chaos ----------
+            time.sleep(1.0)  # let the tracing server flush its logs
+            chk = subprocess.run(
+                [sys.executable, "-m", "distpow_tpu.cli.trace_check",
+                 ts_cfg["OutputFile"], ts_cfg["ShivizOutputFile"]],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=60,
+            )
+            gate("trace_check: 0 violations", chk.returncode == 0,
+                 (chk.stdout + chk.stderr).strip().splitlines()[-1]
+                 if (chk.stdout + chk.stderr).strip() else "")
+
+            print(json.dumps({
+                "metric": "cluster smoke: 2-process pool, one shard "
+                          "SIGKILLed mid-load, zero client errors",
+                "mines": N_MINES * 2,
+                "failovers": failovers,
+                "failover_max_s": round(hist.get("max", 0.0), 3),
+                "pool": client_cfg.CoordAddrs,
+                "ok": True,
+            }))
+            return 0
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.send_signal(signal.SIGKILL)
+            for p in procs.values():
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,22 @@
+"""Fixture: modulo-routing must fire on hash-over-membership modulo."""
+
+import hashlib
+import zlib
+
+
+def route_builtin_hash(nonce, members):
+    # finding 1: the builtin hash() reduced modulo the member count
+    return members[hash(nonce) % len(members)]
+
+
+def route_digest(nonce, workers):
+    # finding 2: a digest() reduced modulo the worker count
+    return workers[
+        int.from_bytes(hashlib.md5(nonce).digest()[:4], "big")
+        % len(workers)
+    ]
+
+
+def route_crc(nonce, shard_addrs):
+    # finding 3: crc32 modulo the shard list
+    return zlib.crc32(nonce) % len(shard_addrs)

@@ -1,0 +1,25 @@
+"""Fixture: sanctioned shapes the modulo-routing rule must NOT flag."""
+
+
+def rotate_placement(i, candidates):
+    # hash-free round-robin index arithmetic: load balancing, not key
+    # routing — no cache locality to lose (coordinator _issue_shards)
+    return candidates[i % len(candidates)]
+
+
+def ring_route(ring, nonce):
+    # the sanctioned shape: consistent-hash ring lookup (~1/N churn)
+    return ring.owner(nonce)
+
+
+def bucket_stat(value_hash, n_buckets):
+    # modulo over a NON-membership count (histogram bucketing): the
+    # right side carries no member-collection hint
+    return value_hash % n_buckets
+
+
+def legacy_static_route(nonce, members):
+    # distpow: ok modulo-routing -- fixture: membership is a frozen
+    # boot-time constant in this (hypothetical) path, so remap churn
+    # cannot occur
+    return members[hash(nonce) % len(members)]

@@ -569,3 +569,77 @@ def test_finalize_membership_only_attaches_forensics(bench):
     assert prov is None
     assert line["unit"] == "s"
     assert line["forensics"] == FO
+
+
+# -- cluster-scale stage (ISSUE 15) -------------------------------------------
+
+CS = {
+    "rate_hz": 150.0, "duration_s": 2.0, "max_inflight": 4,
+    "solve_delay_s": 0.15,
+    "pools": {
+        "n1": {"coordinators": 1, "issued": 296, "completed": 296,
+               "request_errors": 0, "wall_s": 11.31,
+               "solves_per_s": 26.17},
+        "n2": {"coordinators": 2, "issued": 285, "completed": 285,
+               "request_errors": 0, "wall_s": 6.16,
+               "solves_per_s": 46.24},
+        "n4": {"coordinators": 4, "issued": 302, "completed": 302,
+               "request_errors": 0, "wall_s": 4.24,
+               "solves_per_s": 71.19},
+    },
+    "speedup": {"n2_vs_n1": 1.77, "n4_vs_n1": 2.72},
+    "ok": True, "wall_s": 21.9,
+}
+
+
+def test_finalize_attaches_cluster_scale_row(bench):
+    """The cluster-scale stage rides both artifacts of a normal run,
+    like the other tunnel-independent rows."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_FULL, 5.35e6, cluster_scale=CS
+    )
+    assert line["cluster_scale"] == CS
+    assert prov["cluster_scale"] == CS
+    assert line["unit"] == "MH/s"
+
+
+def test_finalize_cluster_scale_only_run(bench):
+    """bench.py --cluster-scale: the headline is the largest pool's
+    aggregate-solves/s speedup and kernel provenance is NOT
+    re-stamped."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None,
+                                       cluster_scale=CS)
+    assert prov is None
+    assert line["unit"] == "x"
+    assert line["value"] == 2.72
+    assert "4-coordinator pool" in line["metric"]
+    assert line["cluster_scale"] == CS
+
+
+def test_finalize_carries_forward_cluster_scale(bench):
+    lm = dict(LAST_FULL, cluster_scale=CS)
+    line, prov = bench.finalize_record({"serving": 9800.0e6}, lm, 5.35e6)
+    assert prov["cluster_scale"] == CS
+    assert "cluster_scale" not in line
+
+
+def test_finalize_control_plane_headline_attaches_cluster_scale(bench):
+    """Device-unreachable runs that measured both CPU stages: the
+    control-plane row stays the headline, cluster-scale rides along."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, control_plane=CP, cluster_scale=CS
+    )
+    assert prov is None
+    assert line["unit"] == "ms"
+    assert line["cluster_scale"] == CS
+
+
+def test_finalize_forensics_only_attaches_cluster_scale(bench):
+    """A forensics-headline run still carries the cluster-scale dict."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, forensics=FO, cluster_scale=CS
+    )
+    assert prov is None
+    assert line["unit"] == "x"
+    assert "spans+exemplars" in line["metric"]
+    assert line["cluster_scale"] == CS

@@ -1,0 +1,703 @@
+"""Coordinator scale-out plane (ISSUE 15, docs/CLUSTER.md): ring
+properties, the NOT_OWNER redirect protocol, hedged sibling retry,
+shard-death failover, epoch-namespaced round-id fencing, shared-worker
+reply-to routing, pool discovery and config generation.
+
+Everything here is CPU-only and jax-free (python backends over
+localhost RPC), so the whole file rides tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+
+import pytest
+
+from distpow_tpu.cluster import (
+    ClusterState,
+    HashRing,
+    NotOwnerError,
+    ring_from_peers,
+)
+from distpow_tpu.load.harness import InProcCluster
+from distpow_tpu.models import puzzle
+from distpow_tpu.nodes.coordinator import new_round_id
+from distpow_tpu.nodes.worker import TaskRound, _rid_order, _rid_split
+from distpow_tpu.runtime import rpc, wire
+from distpow_tpu.runtime.metrics import REGISTRY as metrics
+from distpow_tpu.sched.admission import AdmissionReject
+
+
+def _sample_nonces(n: int = 2000):
+    # deterministic keyspace sample: 2-byte nonces are enough to cover
+    # every ring arc at 64 vnodes
+    return [bytes([i % 256, i // 256]) for i in range(n)]
+
+
+# -- ring math (the routing contract) ---------------------------------------
+
+def test_ring_is_deterministic_and_wire_roundtrips():
+    peers = ["h0:1", "h1:2", "h2:3"]
+    a, b = ring_from_peers(peers), ring_from_peers(peers)
+    nonces = _sample_nonces(512)
+    assert [a.owner(x) for x in nonces] == [b.owner(x) for x in nonces]
+    c = HashRing.from_wire(a.to_wire())
+    assert c == a
+    assert [c.owner(x) for x in nonces] == [a.owner(x) for x in nonces]
+    assert a.addr_of("c1") == "h1:2"
+    assert a.addr_of("nope") is None
+
+
+def test_ring_routes_on_nonce_alone_dominance_preserving():
+    """The dominance contract (docs/CLUSTER.md): every difficulty of
+    one nonce maps to ONE shard — the ring key is the nonce alone, so
+    a shard's cache entry at ntz=k dominates every ntz<=k request for
+    that nonce.  Pinned against the coordinator-side ownership check,
+    which is the code that would break it."""
+    ring = ring_from_peers(["h0:1", "h1:2", "h2:3", "h3:4"])
+    state = ClusterState(ring, "c0")
+    for nonce in _sample_nonces(256):
+        owner = ring.owner(nonce)
+        # owns() consults nothing but the nonce; exercising it across
+        # the ntz range documents the contract at the checking site
+        for _ntz in (1, 2, 7, 16):
+            assert ring.owner(nonce) == owner
+            assert state.owns(nonce) == (owner == "c0")
+
+
+def test_ring_walk_orders_distinct_members_owner_first():
+    ring = ring_from_peers(["h0:1", "h1:2", "h2:3"])
+    for nonce in _sample_nonces(64):
+        walk = ring.ordered(nonce)
+        assert walk[0] == ring.owner(nonce)
+        assert sorted(walk) == ["c0", "c1", "c2"]  # distinct, complete
+
+
+def test_adding_a_shard_remaps_bounded_fraction():
+    """Consistent hashing's whole point: N -> N+1 moves ~1/(N+1) of
+    the keyspace, not ~all of it (the modulo-routing failure mode the
+    lint rule freezes out)."""
+    peers4 = [f"h{i}:{i}" for i in range(4)]
+    r4 = ring_from_peers(peers4)
+    r5 = ring_from_peers(peers4 + ["h4:4"])
+    nonces = _sample_nonces(2000)
+    moved = sum(1 for x in nonces if r4.owner(x) != r5.owner(x))
+    frac = moved / len(nonces)
+    assert frac <= 0.35, f"adding 1 of 5 shards remapped {frac:.0%}"
+    # and every key that moved, moved TO the new member — an old
+    # member must never steal keys from another old member
+    for x in nonces:
+        if r4.owner(x) != r5.owner(x):
+            assert r5.owner(x) == "c4"
+
+
+def test_ring_rejects_duplicates_and_empty():
+    with pytest.raises(ValueError):
+        HashRing([])
+    with pytest.raises(ValueError):
+        HashRing([("c0", "a:1"), ("c0", "b:2")])
+    with pytest.raises(ValueError):
+        ClusterState(ring_from_peers(["a:1"]), "c9")
+
+
+# -- wire + rpc plumbing -----------------------------------------------------
+
+def test_not_owner_ring_rides_binary_frame():
+    ring = ring_from_peers(["h0:1", "h1:2"]).to_wire()
+    frame = {"id": 7, "result": None,
+             "error": "NotOwnerError: NOT_OWNER: key is owned by 'c1'",
+             "ring": ring}
+    enc = wire.encode_frame(frame)
+    dec = wire.decode_frame(enc)
+    assert dec == frame
+    # frames WITHOUT a ring stay exactly the pre-cluster encoding
+    plain = {"id": 7, "result": None, "error": "x"}
+    assert wire.decode_frame(wire.encode_frame(plain)) == plain
+    assert wire.FLAG_RING == 0x04
+
+
+def test_rpc_surfaces_not_owner_and_hello_ring():
+    """A handler raising a ring_wire-carrying exception reaches the
+    caller as typed RPCNotOwner on BOTH codecs, and the extended
+    rpc.hello ack carries the advertised ring."""
+    ring_wire = ring_from_peers(["h0:1", "h1:2"]).to_wire()
+
+    class Svc:
+        def Boom(self, params):
+            raise NotOwnerError("c1", ring_wire)
+
+        def Ok(self, params):
+            return {"ok": True}
+
+    server = rpc.RPCServer()
+    server.register("Svc", Svc())
+    server.hello_extra = lambda: {"ring": ring_wire}
+    addr = server.listen("127.0.0.1:0")
+    server.serve_in_background()
+    try:
+        for codec in ("auto", "json"):
+            client = rpc.RPCClient(addr, codec=codec)
+            try:
+                if codec == "auto":
+                    assert client.codec_name == "binary"
+                    assert client.hello_info.get("ring") == ring_wire
+                else:
+                    assert client.hello_info == {}
+                with pytest.raises(rpc.RPCNotOwner) as exc_info:
+                    client.call("Svc.Boom", {}, timeout=5.0)
+                assert exc_info.value.ring == ring_wire
+                assert "NOT_OWNER" in str(exc_info.value)
+                assert client.call("Svc.Ok", {}, timeout=5.0) == {"ok": True}
+            finally:
+                client.close()
+    finally:
+        server.shutdown()
+
+
+# -- round-id namespacing (zombie fencing across pool members) ---------------
+
+def test_round_id_namespace_format_and_split():
+    plain = new_round_id(5)
+    namespaced = new_round_id(5, "c3")
+    assert "." not in plain and len(plain) == 24
+    assert namespaced.startswith("c3.") and len(namespaced) == 27
+    assert _rid_split(plain) == ("", plain)
+    assert _rid_split(namespaced) == ("c3", namespaced[3:])
+    # ordering stays meaningful within one namespace
+    a, b = new_round_id(5, "c3"), new_round_id(5, "c3")
+    assert _rid_order(a) < _rid_order(b)
+    # and pre-epoch bare ids still order below epoch-prefixed ones
+    assert _rid_order("00ff" + "0" * 12) < _rid_order(plain)
+
+
+def test_worker_fencing_ignores_cross_namespace_founds():
+    """Two pool members fanning to one shared worker: a Found tagged
+    with ANOTHER member's namespace must neither cancel nor supersede
+    the live round (their id streams are unordered against each
+    other); same-namespace newer Founds keep the zombie-popping
+    behavior."""
+    import distpow_tpu.nodes.worker as worker_mod
+
+    handler = worker_mod.WorkerRPCHandler.__new__(
+        worker_mod.WorkerRPCHandler)
+    handler._tasks = {}
+    handler._tasks_lock = threading.Lock()
+    key = (b"\x01\x02", 3, 0)
+
+    live = TaskRound(new_round_id(1, "c0"))
+    handler._task_set(key, live)
+    foreign = new_round_id(9, "c1")  # later epoch, DIFFERENT member
+    assert handler._task_take(key, foreign) is None
+    assert handler._task_get(key) is live  # untouched
+    assert not live.superseded and not live.ev.is_set()
+
+    newer_same_ns = new_round_id(9, "c0")
+    assert handler._task_take(key, newer_same_ns) is None
+    assert handler._task_get(key) is None  # zombie popped...
+    assert live.superseded and live.ev.is_set()  # ...and woken silent
+
+
+# -- end-to-end pool ---------------------------------------------------------
+
+def _pool(n_coordinators=2, n_workers=2, **kw):
+    return InProcCluster(n_workers=n_workers, backend="python",
+                         n_coordinators=n_coordinators, **kw)
+
+
+def _mine_ok(cluster, nonce: bytes, ntz: int, timeout: float = 30.0):
+    cluster.client.mine(nonce, ntz)
+    res = cluster.client.notify_queue.get(timeout=timeout)
+    assert res.error is None, f"client-visible error: {res.error}"
+    assert res.nonce == nonce and res.secret is not None
+    assert puzzle.check_secret(nonce, bytes(res.secret), ntz)
+    return res
+
+
+def _nonce_owned_by(ring, member: str, tag: int = 0):
+    for i in range(4096):
+        nonce = bytes([i % 256, (i // 256) % 256, tag])
+        if ring.owner(nonce) == member:
+            return nonce
+    raise AssertionError(f"no nonce owned by {member}")
+
+
+def test_pool_serves_both_shards_with_owner_routing():
+    cluster = _pool()
+    try:
+        ring = cluster.client.pow._ring
+        before_foreign = metrics.get("cluster.foreign_mines")
+        before_redirect = metrics.get("cluster.not_owner_redirects")
+        for member in ("c0", "c1"):
+            _mine_ok(cluster, _nonce_owned_by(ring, member, tag=1), 1)
+        # a correctly-routed pool serves everything at its owner:
+        # no redirects, no foreign serves
+        assert metrics.get("cluster.foreign_mines") == before_foreign
+        assert metrics.get("cluster.not_owner_redirects") == before_redirect
+    finally:
+        cluster.close()
+
+
+def test_pool_same_nonce_all_difficulties_hit_one_dominance_cache():
+    """The reason the ring keys on the nonce alone: a harder solve for
+    a nonce must serve the easier difficulties of the SAME nonce from
+    the owner's dominance cache."""
+    cluster = _pool()
+    try:
+        ring = cluster.client.pow._ring
+        nonce = _nonce_owned_by(ring, "c1", tag=2)
+        _mine_ok(cluster, nonce, 2)
+        before_hits = metrics.get("cache.hit")
+        t0 = time.monotonic()
+        _mine_ok(cluster, nonce, 1)  # dominated by the ntz=2 secret
+        assert metrics.get("cache.hit") > before_hits
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        cluster.close()
+
+
+def test_stale_client_ring_earns_not_owner_and_reroutes():
+    """A client routing by a WRONG ring over WARM links (no fresh dial
+    — the hello refresh channel cannot teach it) gets the typed
+    redirect, adopts the carried snapshot, and completes at the true
+    owner — one extra round trip, no retry-budget burn."""
+    cluster = _pool()
+    try:
+        pow_ = cluster.client.pow
+        true_ring = pow_._ring
+        a0 = true_ring.addr_of("c0")
+        # warm the c0 link so the misroute below reuses it (hello
+        # extras are consumed at dial time, never re-taught)
+        _mine_ok(cluster, _nonce_owned_by(true_ring, "c0", tag=3), 1)
+        nonce = _nonce_owned_by(true_ring, "c1", tag=3)
+        # a ring that maps EVERY key to c0: the c1-owned key misroutes
+        with pow_._ring_lock:
+            pow_._ring = HashRing([("c0", a0)])
+        before = {k: metrics.get(k) for k in
+                  ("cluster.reroutes", "cluster.not_owner_redirects",
+                   "powlib.retries")}
+        _mine_ok(cluster, nonce, 1)
+        assert metrics.get("cluster.not_owner_redirects") > \
+            before["cluster.not_owner_redirects"]
+        assert metrics.get("cluster.reroutes") > before["cluster.reroutes"]
+        # a redirect is the server working as designed, not an outage
+        assert metrics.get("powlib.retries") == before["powlib.retries"]
+        # the adopted snapshot is the pool's true ring
+        assert pow_._ring == true_ring
+    finally:
+        cluster.close()
+
+
+def test_retry_after_hedges_to_sibling_without_burning_budget():
+    """ISSUE 15 satellite: RETRY_AFTER on the owner routes the request
+    to a sibling WITHOUT consuming the retry budget, and the winning
+    reply's trace shape is pinned (identical to a plain mine)."""
+    from distpow_tpu.runtime.tracing import MemorySink
+
+    sink = MemorySink()
+    cluster = _pool(client_extra={})
+    try:
+        # rebuild the client with a sink so the trace shape is visible
+        cluster.client.close()
+        from distpow_tpu.nodes import Client
+        from distpow_tpu.runtime.config import ClientConfig
+
+        cluster.client = Client(ClientConfig(
+            ClientID="hedger", CoordAddr=cluster.client_addr,
+            CoordAddrs=cluster.client_addrs, ChCapacity=100,
+        ), sink=sink)
+        cluster.client.initialize()
+        ring = cluster.client.pow._ring
+        nonce = _nonce_owned_by(ring, "c0", tag=4)
+        # saturate the OWNER's admission plane: every Mine it receives
+        # is shed with the typed RETRY_AFTER
+        owner_handler = cluster.coordinators[0].handler
+        owner_handler._sched_max_inflight = 1
+        owner_handler._sched_inflight = 1
+        before = {k: metrics.get(k) for k in
+                  ("powlib.retries", "powlib.retry_after",
+                   "cluster.sibling_hedges", "cluster.foreign_mines",
+                   "sched.admission_rejected")}
+        t0 = time.monotonic()
+        _mine_ok(cluster, nonce, 1)
+        wall = time.monotonic() - t0
+        assert metrics.get("sched.admission_rejected") > \
+            before["sched.admission_rejected"]
+        assert metrics.get("cluster.sibling_hedges") > \
+            before["cluster.sibling_hedges"]
+        assert metrics.get("cluster.foreign_mines") > \
+            before["cluster.foreign_mines"]
+        assert metrics.get("powlib.retry_after") > \
+            before["powlib.retry_after"]
+        # NON-COUNTING: the transport retry budget is untouched
+        assert metrics.get("powlib.retries") == before["powlib.retries"]
+        # hedged, not parked: the sibling absorbed the mine immediately
+        # instead of the client waiting out the owner's pacing hint
+        assert wall < 10.0
+        # the winning reply's trace shape is the plain-mine shape
+        names = [a[1] for a in sink.actions()]
+        assert names == ["PowlibMiningBegin", "PowlibMine",
+                         "PowlibSuccess", "PowlibMiningComplete"]
+    finally:
+        cluster.close()
+
+
+def test_dead_owner_with_saturated_sibling_stays_non_counting():
+    """Review PR 10 regression: owner shard dead AND the failover
+    sibling shedding load — every server-paced retry must stay on the
+    live (merely busy) sibling instead of bouncing to the dead owner,
+    which would burn one transport-budget unit per pacing hint and
+    degrade the mine."""
+    cluster = _pool(client_extra={"MineBackoffS": 0.05,
+                                  "MineBackoffMaxS": 0.2})
+    try:
+        ring = cluster.client.pow._ring
+        nonce = _nonce_owned_by(ring, "c0", tag=11)
+        cluster.coordinators[0].shutdown()  # the OWNER dies
+        sib = cluster.coordinators[1].handler
+        sib._sched_retry_after_s = 0.05
+        sib._sched_max_inflight = 1
+        sib._sched_inflight = 1  # saturated: every Mine is shed
+        releases = threading.Timer(
+            1.0, lambda: setattr(sib, "_sched_inflight", 0))
+        releases.start()
+        before = {k: metrics.get(k) for k in
+                  ("powlib.retries", "powlib.retry_after",
+                   "powlib.degraded")}
+        _mine_ok(cluster, nonce, 1, timeout=30.0)
+        releases.join()
+        d_retries = metrics.get("powlib.retries") - before["powlib.retries"]
+        d_after = (metrics.get("powlib.retry_after")
+                   - before["powlib.retry_after"])
+        assert metrics.get("powlib.degraded") == before["powlib.degraded"]
+        # ~1s of 0.05s pacing hints: many server-paced retries...
+        assert d_after >= 3
+        # ...but the transport budget was charged ONLY for the initial
+        # dead-owner failure(s), never once per pacing hint
+        assert d_retries <= 3, \
+            f"{d_retries} budget units burned across {d_after} pacing hints"
+    finally:
+        cluster.close()
+
+
+def test_attempt_timeout_on_healthy_shard_does_not_fail_over():
+    """Review PR 10 regression: a transport-class failure on a HEALTHY
+    connection (attempt timeout — the response frame is merely slow)
+    must re-issue on the same shard like single-coordinator mode, not
+    mis-report a shard death and sacrifice the owner's cache locality
+    with a foreign failover."""
+    from distpow_tpu.runtime import faults
+
+    prev_plan = faults.PLAN
+    cluster = _pool(client_extra={"MineAttemptTimeoutS": 0.4,
+                                  "MineBackoffS": 0.05,
+                                  "MineBackoffMaxS": 0.2})
+    try:
+        ring = cluster.client.pow._ring
+        nonce = _nonce_owned_by(ring, "c1", tag=12)
+        _mine_ok(cluster, nonce, 1)  # warm: links dialed, pool healthy
+        # delay exactly ONE Mine dispatch past the attempt timeout —
+        # the connection stays healthy throughout
+        faults.install_from_spec({"seed": 151, "rules": [
+            {"kind": "delay", "side": "server",
+             "method": "CoordRPCHandler.Mine", "delay_s": 1.2, "max": 1},
+        ]})
+        before = {k: metrics.get(k) for k in
+                  ("cluster.failovers", "cluster.foreign_mines",
+                   "powlib.retries")}
+        nonce2 = _nonce_owned_by(ring, "c1", tag=13)
+        _mine_ok(cluster, nonce2, 1, timeout=30.0)
+        assert metrics.get("powlib.retries") > before["powlib.retries"]
+        assert metrics.get("cluster.failovers") == \
+            before["cluster.failovers"]
+        assert metrics.get("cluster.foreign_mines") == \
+            before["cluster.foreign_mines"]
+    finally:
+        faults.install(prev_plan)
+        cluster.close()
+
+
+def test_fresh_dial_hello_ack_refreshes_stale_ring():
+    """The extended rpc.hello's ring advertisement is a live refresh
+    channel: a client whose stale ring routes a fresh dial at the
+    wrong member adopts the advertised ring BEFORE issuing — no
+    NOT_OWNER round trip needed."""
+    cluster = _pool()
+    try:
+        pow_ = cluster.client.pow
+        true_ring = pow_._ring
+        nonce = _nonce_owned_by(true_ring, "c1", tag=14)
+        a0, a1 = (true_ring.addr_of("c0"), true_ring.addr_of("c1"))
+        with pow_._ring_lock:
+            pow_._ring = HashRing([("c0", a1), ("c1", a0)])
+            pow_._links = {}  # force fresh dials, whose hellos advertise
+        before = {k: metrics.get(k) for k in
+                  ("cluster.reroutes", "cluster.not_owner_redirects")}
+        _mine_ok(cluster, nonce, 1)
+        assert pow_._ring == true_ring
+        # the hello taught the client before any misroute reached a
+        # coordinator: no redirect was minted anywhere
+        assert metrics.get("cluster.not_owner_redirects") == \
+            before["cluster.not_owner_redirects"]
+        assert metrics.get("cluster.reroutes") == \
+            before["cluster.reroutes"]
+    finally:
+        cluster.close()
+
+
+def test_client_single_entry_coord_addrs_is_honored():
+    """Review PR 10 regression: CoordAddrs=[one-addr] with an empty
+    CoordAddr must dial that one address (plain single mode), not the
+    empty default."""
+    from distpow_tpu.nodes import Client
+    from distpow_tpu.runtime.config import ClientConfig
+
+    cluster = _pool(n_coordinators=1)
+    try:
+        c = Client(ClientConfig(
+            ClientID="solo", CoordAddr="",
+            CoordAddrs=[cluster.client_addr], ChCapacity=10,
+        ))
+        c.initialize()
+        try:
+            assert c.pow._ring is None  # one member = plain single mode
+            assert c.pow.coord_addr == cluster.client_addr
+            c.mine(b"\x0f\x01", 1)
+            assert c.notify_queue.get(timeout=30).error is None
+        finally:
+            c.close()
+    finally:
+        cluster.close()
+
+
+def test_shard_death_fails_over_with_zero_client_errors():
+    """Chaos acceptance (in-process half; scripts/cluster_smoke.py does
+    the real-SIGKILL version): kill one of two coordinators while keys
+    it owns are mined — every mine completes via ring failover, no
+    client-visible errors."""
+    cluster = _pool(client_extra={"MineBackoffS": 0.05,
+                                  "MineBackoffMaxS": 0.3})
+    try:
+        ring = cluster.client.pow._ring
+        victim = "c1"
+        nonces = [_nonce_owned_by(ring, m, tag=5 + i)
+                  for i, m in enumerate(("c0", "c1", "c1", "c0"))]
+        _mine_ok(cluster, nonces[0], 1)  # warm: the pool serves
+        before = metrics.get("cluster.failovers")
+        cluster.coordinators[1].shutdown()
+        for nonce in nonces[1:]:
+            _mine_ok(cluster, nonce, 1)
+        assert metrics.get("cluster.failovers") > before
+        snap = metrics.snapshot()["histograms"].get("cluster.failover_s")
+        assert snap and snap["count"] >= 1
+        assert ring.owner(nonces[1]) == victim  # the dead shard's key
+    finally:
+        cluster.close()
+
+
+def test_pool_under_open_loop_load_with_mid_run_shard_kill():
+    """The PR 7 harness drives a 2-member pool while one member dies
+    mid-load: zero client-visible Mine errors (acceptance criterion)."""
+    from distpow_tpu.load.loadgen import LoadMix, OpenLoopRunner, \
+        build_schedule
+
+    cluster = _pool(client_extra={"MineBackoffS": 0.05,
+                                  "MineBackoffMaxS": 0.3,
+                                  "MineRetries": 8})
+    try:
+        mix = LoadMix(rate_hz=20.0, duration_s=1.5, seed=7,
+                      n_keys=64, zipf_s=0.0, difficulties=((1, 1.0),))
+        schedule = build_schedule(mix)
+        done, errors = [0], []
+        stop = threading.Event()
+
+        def drain():
+            q = cluster.client.notify_queue
+            while not stop.is_set():
+                try:
+                    res = q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                done[0] += 1
+                if res.error:
+                    errors.append(str(res.error))
+
+        drainer = threading.Thread(target=drain, daemon=True)
+        drainer.start()
+        killer = threading.Timer(0.5, cluster.coordinators[1].shutdown)
+        killer.start()
+        report = OpenLoopRunner(
+            lambda arr: cluster.client.mine(arr.nonce, arr.ntz)
+        ).run(schedule)
+        killer.join()
+        deadline = time.monotonic() + 60.0
+        expected = report.issued - report.submit_errors
+        while done[0] < expected and time.monotonic() < deadline:
+            time.sleep(0.02)
+        stop.set()
+        drainer.join(timeout=2.0)
+        assert report.submit_errors == 0
+        assert done[0] == expected, \
+            f"only {done[0]}/{expected} completions after shard kill"
+        assert errors == [], f"client-visible errors: {errors[:3]}"
+    finally:
+        cluster.close()
+
+
+# -- shared-worker reply-to routing ------------------------------------------
+
+def test_pooled_rounds_stamp_reply_to_and_workers_route_home():
+    """Each member's rounds carry its own worker-facing address, and
+    the shared workers' forwarder delivers Results there — the config
+    default (member 0) must not receive member 1's results."""
+    cluster = _pool()
+    try:
+        ring = cluster.client.pow._ring
+        h0, h1 = (c.handler for c in cluster.coordinators)
+        assert h0.reply_addr and h1.reply_addr
+        assert h0.reply_addr != h1.reply_addr
+        params = h1._mine_params(
+            _FakeTrace(), b"\x01", 1, 0, "c1.deadbeef")
+        assert params["coord_addr"] == h1.reply_addr
+        before = metrics.get("coord.mine_rpcs")
+        # e2e: a c1-owned mine completes => its Results reached c1
+        # (c0 would drop them as unknown-task noise and c1's round
+        # would hang past this timeout)
+        _mine_ok(cluster, _nonce_owned_by(ring, "c1", tag=9), 1,
+                 timeout=20.0)
+        assert metrics.get("coord.mine_rpcs") > before
+    finally:
+        cluster.close()
+
+
+class _FakeTrace:
+    trace_id = 1
+
+    def record_action(self, *a, **k):
+        pass
+
+    def generate_token(self):
+        return json.dumps({"trace_id": 1}).encode()
+
+
+# -- discovery + config generation -------------------------------------------
+
+def test_discover_expands_pool_and_dedup_merges_members():
+    from distpow_tpu.cli.stats import discover_cluster_addrs
+
+    cluster = _pool(n_workers=2)
+    try:
+        # ONE seed expands to the whole pool (the Stats snapshot's
+        # ring) and merges both members' Fleet.Members tables
+        addrs = discover_cluster_addrs(cluster.client_addrs[0])
+        for coord_addr in cluster.client_addrs:
+            assert coord_addr in addrs
+        for worker_addr in cluster.worker_addrs:
+            assert worker_addr in addrs
+        assert len(addrs) == len(set(addrs))  # dedup
+        # multiple seeds (the repeatable --discover flag) dedup too
+        addrs2 = discover_cluster_addrs(list(cluster.client_addrs))
+        assert sorted(addrs2) == sorted(addrs)
+    finally:
+        cluster.close()
+
+
+def test_config_gen_coordinators_emits_round_tripping_pool(tmp_path):
+    from distpow_tpu.cli import config_gen
+    from distpow_tpu.runtime.config import (
+        ClientConfig,
+        CoordinatorConfig,
+        read_json_config,
+    )
+
+    d = str(tmp_path)
+    config_gen.main(["--config-dir", d, "--workers", "2",
+                     "--coordinators", "3", "--seed", "11"])
+    paths = [os.path.join(d, "coordinator_config.json"),
+             os.path.join(d, "coordinator1_config.json"),
+             os.path.join(d, "coordinator2_config.json")]
+    coords = [read_json_config(p, CoordinatorConfig) for p in paths]
+    peers = coords[0].ClusterPeers
+    assert len(peers) == 3 and len(set(peers)) == 3
+    for i, c in enumerate(coords):
+        assert c.ClusterPeers == peers
+        assert c.ClusterSelf == i
+        assert c.ClientAPIListenAddr == peers[i]
+        assert c.Workers == coords[0].Workers  # ONE shared fleet
+        assert len(c.Workers) == 2
+    listen_addrs = {c.WorkerAPIListenAddr for c in coords}
+    assert len(listen_addrs) == 3
+    client = read_json_config(os.path.join(d, "client_config.json"),
+                              ClientConfig)
+    assert client.CoordAddrs == peers
+    assert client.CoordAddr == peers[0]
+    # the ring both sides derive from those configs is identical
+    assert ring_from_peers(peers) == ring_from_peers(client.CoordAddrs)
+
+    # inherited per-process paths get per-shard suffixes (two shards
+    # sharing one cache journal would corrupt both)
+    d3 = str(tmp_path / "paths")
+    os.makedirs(d3)
+    from distpow_tpu.runtime.config import write_json_config
+    write_json_config(os.path.join(d3, "coordinator_config.json"),
+                      CoordinatorConfig(CacheFile="/var/x.journal",
+                                        TelemetryDir="/var/tel"))
+    config_gen.main(["--config-dir", d3, "--workers", "2",
+                     "--coordinators", "2", "--seed", "13"])
+    c0 = read_json_config(os.path.join(d3, "coordinator_config.json"),
+                          CoordinatorConfig)
+    c1 = read_json_config(os.path.join(d3, "coordinator1_config.json"),
+                          CoordinatorConfig)
+    assert c0.CacheFile == "/var/x.journal"
+    assert c1.CacheFile == "/var/x.journal.c1"
+    assert c0.TelemetryDir != c1.TelemetryDir
+
+    # --coordinators 1 (the default) emits the historical single shape
+    d2 = str(tmp_path / "single")
+    config_gen.main(["--config-dir", d2, "--workers", "2", "--seed", "12"])
+    single = read_json_config(
+        os.path.join(d2, "coordinator_config.json"), CoordinatorConfig)
+    assert single.ClusterPeers == [] and single.ClusterSelf == -1
+    sclient = read_json_config(os.path.join(d2, "client_config.json"),
+                               ClientConfig)
+    assert sclient.CoordAddrs == []
+    assert not os.path.exists(os.path.join(d2, "coordinator1_config.json"))
+
+
+def test_cluster_ring_rpc_and_invalid_self_rejected():
+    cluster = _pool()
+    try:
+        client = rpc.RPCClient(cluster.client_addrs[1], codec="json")
+        try:
+            reply = client.call("Cluster.Ring", {}, timeout=5.0)
+        finally:
+            client.close()
+        assert reply["self"] == "c1"
+        assert HashRing.from_wire(reply["ring"]) == \
+            cluster.client.pow._ring
+    finally:
+        cluster.close()
+    from distpow_tpu.nodes import Coordinator
+    from distpow_tpu.runtime.config import CoordinatorConfig
+
+    with pytest.raises(ValueError):
+        Coordinator(CoordinatorConfig(
+            ClientAPIListenAddr="127.0.0.1:0",
+            WorkerAPIListenAddr="127.0.0.1:0",
+            Workers=["pending:0"],
+            ClusterPeers=["a:1", "b:2"], ClusterSelf=7,
+        ))
+
+
+def test_admission_reject_still_typed_for_single_coordinator():
+    """Guard: the cluster exception plumbing must not perturb the
+    existing RETRY_AFTER typing (both carry extra response fields)."""
+    assert issubclass(rpc.RPCNotOwner, rpc.RPCError)
+    reject = AdmissionReject(0.25, "full")
+    assert reject.retry_after_s == 0.25
+    err = NotOwnerError("c2", {"version": 0, "vnodes": 64,
+                              "members": [["c2", "x:1"]]})
+    assert err.ring_wire["members"] == [["c2", "x:1"]]

@@ -127,6 +127,13 @@ CASES = [
     # recorders, and the justified cross-thread suppression
     ("unclosed-span", os.path.join("sched", "unclosed_span_bad.py"),
      os.path.join("sched", "unclosed_span_ok.py"), 3),
+    # coordinator scale-out (ISSUE 15): hash % len(members) routing
+    # remaps ~every key on membership change — the consistent-hash
+    # ring (cluster/ring.py) is the sanctioned shape; the ok fixture
+    # blesses hash-free rotation, ring lookups, non-membership modulo,
+    # and the suppression protocol
+    ("modulo-routing", os.path.join("nodes", "modulo_routing_bad.py"),
+     os.path.join("nodes", "modulo_routing_ok.py"), 3),
 ]
 
 
