@@ -147,7 +147,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                     load_slo: dict | None = None,
                     membership: dict | None = None,
                     forensics: dict | None = None,
-                    cluster_scale: dict | None = None):
+                    cluster_scale: dict | None = None,
+                    cache_ha: dict | None = None):
     """Build the stdout JSON line and the provenance record, once.
 
     Shared by the success path and the hang bailout (review r5: two
@@ -195,6 +196,27 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
     all_suspect.update(suspect)
     md5_acc = {l: v for l, v in accepted.items() if l in MD5_LABELS}
     if not md5_acc:
+        if cache_ha and not (control_plane or serving_loop or load_slo
+                             or membership or forensics or cluster_scale):
+            # a cache-HA-only run (bench.py --cache-ha): the seventh
+            # tunnel-independent perf row (ISSUE 16) — repeat-wave
+            # cache-hit ratio on the surviving pool after a member
+            # kill, replication on vs off (the 1.0-ratio / zero-fanout
+            # floors are asserted inside the stage).  Kernel
+            # provenance stays untouched (prov None) like the other
+            # CPU-only shapes.
+            line = {
+                "metric": ("cache-HA repeat hit ratio on the survivor "
+                           "after a coordinator kill, replication on "
+                           "vs off (CPU, tunnel-independent)"),
+                "value": cache_ha.get("hit_ratio_on", 0.0),
+                "unit": "ratio",
+                "vs_baseline": cache_ha.get("on_vs_off_x", 0.0),
+                "cache_ha": cache_ha,
+            }
+            if note:
+                line["note"] = note
+            return line, None
         if cluster_scale and not (control_plane or serving_loop
                                   or load_slo or membership or forensics):
             # a cluster-scale-only run (bench.py --cluster-scale): the
@@ -216,6 +238,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 "vs_baseline": 0.0,
                 "cluster_scale": cluster_scale,
             }
+            if cache_ha:
+                line["cache_ha"] = cache_ha
             if note:
                 line["note"] = note
             return line, None
@@ -238,6 +262,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
             }
             if cluster_scale:
                 line["cluster_scale"] = cluster_scale
+            if cache_ha:
+                line["cache_ha"] = cache_ha
             if note:
                 line["note"] = note
             return line, None
@@ -271,6 +297,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 line["forensics"] = forensics
             if cluster_scale:
                 line["cluster_scale"] = cluster_scale
+            if cache_ha:
+                line["cache_ha"] = cache_ha
             if note:
                 line["note"] = note
             return line, None
@@ -300,6 +328,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 line["forensics"] = forensics
             if cluster_scale:
                 line["cluster_scale"] = cluster_scale
+            if cache_ha:
+                line["cache_ha"] = cache_ha
             if note:
                 line["note"] = note
             return line, None
@@ -325,6 +355,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 line["forensics"] = forensics
             if cluster_scale:
                 line["cluster_scale"] = cluster_scale
+            if cache_ha:
+                line["cache_ha"] = cache_ha
             if note:
                 line["note"] = note
             return line, None
@@ -359,6 +391,8 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
                 line["forensics"] = forensics
             if cluster_scale:
                 line["cluster_scale"] = cluster_scale
+            if cache_ha:
+                line["cache_ha"] = cache_ha
             if note:
                 line["note"] = note
             return line, None
@@ -477,6 +511,11 @@ def finalize_record(rates_hs: dict, last_measured: dict | None,
         prov["cluster_scale"] = cluster_scale
     elif (last_measured or {}).get("cluster_scale"):
         prov["cluster_scale"] = last_measured["cluster_scale"]
+    if cache_ha:
+        line["cache_ha"] = cache_ha
+        prov["cache_ha"] = cache_ha
+    elif (last_measured or {}).get("cache_ha"):
+        prov["cache_ha"] = last_measured["cache_ha"]
     return line, prov
 
 
@@ -1189,6 +1228,169 @@ def cluster_scale_stage(pool_sizes=(1, 2, 4), rate_hz=150.0,
     return out
 
 
+def cache_ha_stage(n_keys=12, warm_ntz=2, drain_timeout_s=60.0,
+                   converge_timeout_s=20.0) -> dict:
+    """Replicated-dominance-cache HA stage (``--cache-ha``): CPU-only,
+    zero tunnel dependence (ISSUE 16, docs/CLUSTER.md "Replication &
+    HA").
+
+    Two arms over identical fresh 2-coordinator in-process pools
+    (python-backend workers, localhost RPC), differing ONLY in
+    ``ClusterCacheReplicas``: warm a key set split evenly across both
+    shards at ``warm_ntz``, wait for write-behind replication to land
+    every one of c1's entries on the survivor (peeked via the
+    unmetered ``satisfies`` — the replication-off arm has nothing to
+    wait for), KILL member c1, then re-mine every key as a dominated
+    repeat (ntz=1).  The measurement is the repeat wave's cache-hit
+    ratio on the surviving pool:
+
+    * replication ON (``ClusterCacheReplicas=1``, the default):
+      every repeat — the dead member's keys included — is served from
+      the survivor's replicated dominance cache.  Floors asserted
+      into ``ok``: hit ratio 1.0, ZERO fan-out rounds, zero client
+      errors;
+    * replication OFF (``ClusterCacheReplicas=0``): the dead member's
+      keys MISS on the survivor (the ``no_redirect`` failover serve)
+      and are RE-MINED — the stage's vs-row is that ratio gap.
+      Floors: every dead-owned repeat re-mines (one fan-out round
+      each) and the off-arm ratio is exactly the survivor's own
+      share.
+
+    Anti-entropy is disabled in both arms (``ClusterAntiEntropyS=0``)
+    so the ON arm isolates the write-behind path and the OFF arm
+    cannot heal itself.  "Hit" here is the ``coord.mine_s.hit``
+    histogram count (the FIRST-lookup warm-serve path), not the raw
+    ``cache.hit`` counter — the miss path's final result collection
+    re-reads the cache and would double-count every re-mined key.
+    Deltas are taken around the repeat wave on the process-global
+    REGISTRY — valid because the dead member is already down when the
+    wave starts, so only the survivor can tick them.
+    """
+    import queue as _q
+
+    from distpow_tpu.load.harness import InProcCluster
+    from distpow_tpu.runtime.metrics import REGISTRY
+
+    stage_t0 = time.time()
+
+    def run_arm(replicas: int) -> dict:
+        cluster = InProcCluster(
+            n_workers=2, backend="python", n_coordinators=2,
+            coord_extra={
+                "ClusterCacheReplicas": replicas,
+                "ClusterAntiEntropyS": 0.0,
+            },
+        )
+        try:
+            # an even shard split by construction: scan the tag space
+            # for the first n/2 keys each member owns, so the off-arm
+            # miss count is pinned at exactly n/2 regardless of how
+            # the vnode hash happens to carve this pool's ring
+            ring = cluster.client.pow._ring
+            owned = {"c0": [], "c1": []}
+            for i in range(512):
+                x = bytes([i & 0xFF, 0x2F ^ (i >> 8)])
+                side = ring.owner(x)
+                if len(owned[side]) < n_keys // 2:
+                    owned[side].append(x)
+                if all(len(v) >= n_keys // 2 for v in owned.values()):
+                    break
+            keys = owned["c0"] + owned["c1"]
+            notify = cluster.client.notify_queue
+
+            def mine_wave(ntz: int):
+                for x in keys:
+                    cluster.client.mine(x, ntz)
+                got, errors = [], []
+                deadline = time.monotonic() + drain_timeout_s
+                while len(got) < len(keys) \
+                        and time.monotonic() < deadline:
+                    try:
+                        res = notify.get(timeout=0.2)
+                    except _q.Empty:
+                        continue
+                    got.append(res)
+                    if res.error:
+                        errors.append(str(res.error))
+                return got, errors
+
+            warm_got, warm_errors = mine_wave(warm_ntz)
+            survivor = cluster.coordinators[0].handler.result_cache
+            converged = True
+            if replicas > 0:
+                deadline = time.monotonic() + converge_timeout_s
+                while time.monotonic() < deadline:
+                    if all(survivor.satisfies(x, warm_ntz) is not None
+                           for x in owned["c1"]):
+                        break
+                    time.sleep(0.05)
+                converged = all(
+                    survivor.satisfies(x, warm_ntz) is not None
+                    for x in owned["c1"])
+            def warm_serves() -> int:
+                h = REGISTRY.get_histogram("coord.mine_s.hit") or {}
+                return int(h.get("count", 0))
+
+            pre_hits = warm_serves()
+            pre_fanouts = REGISTRY.get("coord.fanouts")
+            cluster.kill_coordinator(1)
+            got, errors = mine_wave(1)  # dominated repeats
+            d_hits = warm_serves() - pre_hits
+            d_fanouts = REGISTRY.get("coord.fanouts") - pre_fanouts
+            return {
+                "replicas": replicas,
+                "keys": len(keys),
+                "dead_owned": len(owned["c1"]),
+                "warm_completed": len(warm_got),
+                "warm_errors": len(warm_errors),
+                "converged": converged,
+                "repeat_completed": len(got),
+                "repeat_errors": len(errors),
+                "repeat_hits": d_hits,
+                "repeat_fanouts": d_fanouts,
+                "repeat_hit_ratio": round(d_hits / max(len(keys), 1),
+                                          3),
+            }
+        finally:
+            cluster.close()
+
+    out: dict = {"warm_ntz": warm_ntz, "n_keys": n_keys,
+                 "arms": {}, "ok": True}
+    for label, replicas in (("repl_on", 1), ("repl_off", 0)):
+        arm = run_arm(replicas)
+        out["arms"][label] = arm
+        print(f"[bench] cache-ha {label}: "
+              f"{arm['repeat_hits']}/{arm['keys']} repeat hits "
+              f"({arm['repeat_fanouts']} re-mine fan-outs, "
+              f"{arm['repeat_errors']} errors, "
+              f"converged={arm['converged']})", file=sys.stderr)
+    on, off = out["arms"]["repl_on"], out["arms"]["repl_off"]
+    out["hit_ratio_on"] = on["repeat_hit_ratio"]
+    out["hit_ratio_off"] = off["repeat_hit_ratio"]
+    out["on_vs_off_x"] = round(
+        on["repeat_hit_ratio"] / max(off["repeat_hit_ratio"], 1e-9), 2)
+    # acceptance floors (ISSUE 16): the ON arm rides the kill with a
+    # perfect warm-repeat ratio and zero re-mines; the OFF arm pays a
+    # re-mine for every key the dead member owned
+    if not (on["converged"]
+            and on["warm_errors"] == 0 and on["repeat_errors"] == 0
+            and on["repeat_hits"] >= on["keys"]
+            and on["repeat_fanouts"] == 0):
+        out["ok"] = False
+        print("[bench] WARNING: cache-ha replication-on arm missed its "
+              "floors (want full repeat-hit coverage with zero "
+              "fan-outs)", file=sys.stderr)
+    if not (off["warm_errors"] == 0 and off["repeat_errors"] == 0
+            and off["repeat_hits"] <= off["keys"] - off["dead_owned"]
+            and off["repeat_fanouts"] >= off["dead_owned"]):
+        out["ok"] = False
+        print("[bench] WARNING: cache-ha replication-off arm did not "
+              "show the expected miss gap (dead member's keys should "
+              "re-mine)", file=sys.stderr)
+    out["wall_s"] = round(time.time() - stage_t0, 1)
+    return out
+
+
 def membership_stage(straggler_cap_s=8.0, solve_delay_s=1.0) -> dict:
     """Elastic-membership latency stage (``--membership``): CPU-only,
     in-process cluster, zero tunnel dependence (ISSUE 12).
@@ -1885,6 +2087,18 @@ def main() -> None:
                                   cluster_scale=cs)
         print(json.dumps(line))
         return
+    if "--cache-ha" in sys.argv:
+        # standalone cache-HA run (ISSUE 16): CPU-only by construction
+        # — python-backend workers over in-process RPC, no jax and no
+        # device probe; the 1.0-hit-ratio / zero-fanout floors are
+        # asserted inside the stage and the line rides
+        # finalize_record's cache-ha shape (kernel provenance
+        # untouched)
+        ch = cache_ha_stage()
+        line, _ = finalize_record({}, _read_last_measured(), None,
+                                  cache_ha=ch)
+        print(json.dumps(line))
+        return
     if "--forensics-overhead" in sys.argv:
         # standalone forensics-overhead run (ISSUE 14): CPU-only by
         # construction — python-backend workers over localhost RPC, no
@@ -1957,6 +2171,17 @@ def main() -> None:
                 line["metric"] += "; cluster-scale stage measured on CPU"
             except Exception as exc:
                 print(f"[bench] cluster-scale stage failed: {exc}",
+                      file=sys.stderr)
+        if os.environ.get("BENCH_CACHE_HA") != "0":
+            # seventh tunnel-independent row (ISSUE 16): survivor
+            # repeat-hit ratio after a member kill, replication on vs
+            # off — jax-free like the control-plane stage, with the
+            # hit-ratio/zero-fanout floors asserted inside the stage
+            try:
+                line["cache_ha"] = cache_ha_stage()
+                line["metric"] += "; cache-ha stage measured on CPU"
+            except Exception as exc:
+                print(f"[bench] cache-ha stage failed: {exc}",
                       file=sys.stderr)
         if os.environ.get("BENCH_SERVING_LOOP") != "0":
             # same rationale for the serving-loop row (ISSUE 6), but
@@ -2464,6 +2689,21 @@ def main() -> None:
             print(f"[bench] cluster-scale stage failed: {exc}",
                   file=sys.stderr)
 
+    # ---- Cache-HA stage (CPU, deadline-gated) ------------------------
+    # the replicated-dominance-cache row (ISSUE 16): survivor repeat
+    # hit ratio after a member kill, replication on vs off — python
+    # backends only, so it runs on healthy rounds too (same
+    # carry-forward rationale as the load-slo stage); the hit-ratio
+    # floors are asserted inside the stage
+    cache_ha = None
+    if os.environ.get("BENCH_CACHE_HA") != "0" and \
+            time.time() <= deadline:
+        try:
+            cache_ha = cache_ha_stage()
+        except Exception as exc:
+            print(f"[bench] cache-ha stage failed: {exc}",
+                  file=sys.stderr)
+
     # ---- Final line ---------------------------------------------------
     line, prov = finalize_record(rates, last_measured, baseline,
                                  control_plane=control_plane,
@@ -2471,7 +2711,8 @@ def main() -> None:
                                  load_slo=load_slo,
                                  membership=membership,
                                  forensics=forensics,
-                                 cluster_scale=cluster_scale)
+                                 cluster_scale=cluster_scale,
+                                 cache_ha=cache_ha)
     # the measured roofline rides in provenance: the generated
     # registry-standing table (scripts/gen_registry_table.py) derives
     # utilization percentages from it.  prov is None when no md5 stage

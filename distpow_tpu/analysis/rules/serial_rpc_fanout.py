@@ -19,6 +19,15 @@ deadline concurrent poll exists to prevent (docs/SLO.md).  The rule
 therefore covers ``obs/`` with the same detection and the same
 suppression protocol.
 
+The replication plane (``distpow_tpu/cluster/``, ISSUE 16) is the
+third habitat: write-behind pushes, anti-entropy digest exchanges, and
+warm-handoff sends all loop over peer collections with an RPC inside.
+Some of those loops are DELIBERATELY serial — the single background
+pusher thread is the design, not an accident — but the rule still
+covers ``cluster/`` so every such loop carries its bound in a
+suppression (queue depth, successor count, sweep cadence, deadline)
+instead of being invisibly exempt.
+
 Detection is lexical, like the sibling rules: a ``for`` loop whose
 iterated expression mentions a worker/peer-collection name (any
 identifier containing ``worker``, ``peer``, ``task``, ``ref``,
@@ -41,7 +50,8 @@ from ._util import in_dirs, receiver_name, walk_same_scope
 RULE_ID = "serial-rpc-fanout"
 DESCRIPTION = (
     "no blocking .call() per peer inside a loop over worker/peer/node "
-    "collections in nodes/ or obs/ — issue go() futures, then await"
+    "collections in nodes/, obs/ or cluster/ — issue go() futures, "
+    "then await"
 )
 
 #: identifiers that mark a loop as iterating a peer collection
@@ -65,7 +75,7 @@ def _iter_mentions_peers(iter_expr: ast.AST) -> bool:
 
 
 def check(module, context) -> Iterator:
-    if not in_dirs(module.path, "nodes", "obs"):
+    if not in_dirs(module.path, "nodes", "obs", "cluster"):
         return
     for node in ast.walk(module.tree):
         if not isinstance(node, ast.For):

@@ -23,7 +23,11 @@ Detection is lexical, like the sibling rules: any ``threading.Thread``
 / ``Thread`` constructor call inside a ``for`` or ``while`` loop body —
 nested loops included, nested function/class bodies excluded (a
 callback DEFINED in a loop is not SPAWNED by it).  Scope: ``nodes/``,
-``runtime/`` and ``fleet/``, the layers where per-member loops live.
+``runtime/``, ``fleet/`` and ``cluster/`` — the replication plane
+(ISSUE 16) spawns one warm-handoff sender per new owner, which is
+exactly the per-item-spawn shape this rule exists to make justify its
+bound (pool-size cap + shared handoff deadline, carried in the
+suppression at the spawn site).
 """
 
 from __future__ import annotations
@@ -35,9 +39,9 @@ from ._util import dotted_name, in_dirs
 
 RULE_ID = "unbounded-thread-spawn"
 DESCRIPTION = (
-    "no threading.Thread creation inside loops in nodes//runtime//fleet/ "
-    "— use one persistent thread, a bounded pool, or suppress with the "
-    "bound that makes the per-item spawn safe"
+    "no threading.Thread creation inside loops in nodes//runtime//"
+    "fleet//cluster/ — use one persistent thread, a bounded pool, or "
+    "suppress with the bound that makes the per-item spawn safe"
 )
 
 _THREAD_NAMES = frozenset({"threading.Thread", "Thread"})
@@ -62,7 +66,7 @@ def _loop_body_calls(loop: ast.AST) -> Iterator[ast.Call]:
 
 
 def check(module, context) -> Iterator:
-    if not in_dirs(module.path, "nodes", "runtime", "fleet"):
+    if not in_dirs(module.path, "nodes", "runtime", "fleet", "cluster"):
         return
     for node in ast.walk(module.tree):
         if not isinstance(node, (ast.For, ast.While)):

@@ -7,8 +7,15 @@ and redirects misrouted keys with a typed ``NOT_OWNER`` reply carrying
 a fresh ring snapshot.  powlib (nodes/powlib.py) is the cluster-aware
 client: owner routing, hedged sibling retry on RETRY_AFTER, and
 ring-guided failover when a shard dies.
+
+replication.py makes the partition SURVIVE member death: write-behind
+pushes to each key's ring successors, a slow anti-entropy digest loop
+that heals missed pushes, and a warm shard handoff that moves remapped
+ranges to their new owner before a ring change is acked
+(docs/CLUSTER.md "Replication & HA").
 """
 
+from .replication import Replicator, entry_wire, range_digests
 from .ring import DEFAULT_VNODES, HashRing, ring_from_peers
 from .service import ClusterService, ClusterState, NotOwnerError
 
@@ -19,4 +26,7 @@ __all__ = [
     "ClusterService",
     "ClusterState",
     "NotOwnerError",
+    "Replicator",
+    "entry_wire",
+    "range_digests",
 ]

@@ -15,6 +15,16 @@ The ``Cluster.Ring`` RPC (registered on both coordinator listeners)
 serves the snapshot on demand; the same snapshot rides the extended
 ``rpc.hello`` ack (runtime/rpc.py ``hello_extra``), so a freshly dialed
 client learns the ring in its very first exchange.
+
+The service also carries the replication plane's two peer RPCs
+(cluster/replication.py, docs/CLUSTER.md "Replication & HA"):
+``Cluster.CacheSync`` (write-behind entry pushes and the anti-entropy
+digest exchange) and ``Cluster.Handoff`` (warm shard handoff on
+membership change).  Both funnel installs through the dominance order,
+so a stale push can never regress an entry.  A single-coordinator
+deployment registers the service with ``replicator=None`` — the two
+RPCs then refuse politely and nothing about the pre-cluster wire
+surface changes.
 """
 
 from __future__ import annotations
@@ -66,12 +76,48 @@ class ClusterState:
 
 
 class ClusterService:
-    """The ``Cluster`` RPC service (``Cluster.Ring``)."""
+    """The ``Cluster`` RPC service (``Cluster.Ring`` always;
+    ``Cluster.CacheSync``/``Cluster.Handoff`` when a replicator is
+    wired, i.e. only in pool mode)."""
 
-    def __init__(self, state: ClusterState):
+    def __init__(self, state: ClusterState, replicator=None):
         self._state = state
+        self._replicator = replicator
 
     def Ring(self, params) -> dict:
         metrics.inc("cluster.ring_serves")
         return {"ring": self._state.ring.to_wire(),
                 "self": self._state.self_id}
+
+    def CacheSync(self, params) -> dict:
+        """Replication peer traffic (cluster/replication.py).
+
+        Two shapes share the method so the wire vocabulary stays small:
+        ``{"digest": n_buckets, "self": peer}`` asks for this member's
+        per-ring-range summary digests of the entries ``peer`` owns and
+        the ring replicates here; ``{"entries": [...], "self": peer}``
+        pushes entries, installed through the dominance order — the
+        reply's ``stale`` count is the dominance order rejecting
+        regressions, not an error.
+        """
+        repl = self._replicator
+        if repl is None:
+            raise ValueError("NO_REPLICATION: this coordinator has no "
+                             "replication plane (single-member pool?)")
+        if "digest" in params:
+            return {"digest": repl.digests_for(
+                str(params.get("self", "")), int(params["digest"]))}
+        installed, stale = repl.install(params.get("entries"))
+        return {"installed": installed, "stale": stale}
+
+    def Handoff(self, params) -> dict:
+        """Warm shard handoff receiver: a member losing keys on a ring
+        change pushes the remapped entries here BEFORE acking the new
+        ring.  Same dominance-ordered install as CacheSync — arriving
+        entries can never regress what this member already holds."""
+        repl = self._replicator
+        if repl is None:
+            raise ValueError("NO_REPLICATION: this coordinator has no "
+                             "replication plane (single-member pool?)")
+        installed, stale = repl.install(params.get("entries"))
+        return {"installed": installed, "stale": stale}

@@ -134,12 +134,26 @@ class InProcCluster:
             )
         return targets
 
+    def kill_coordinator(self, i: int) -> None:
+        """Hard-stop pool member ``i`` without draining — the in-proc
+        stand-in for SIGKILL (bench.py ``--cache-ha``; the real-process
+        version lives in scripts/ha_smoke.py).  The member's listeners
+        close and its worker links drop; the client's next Mine on a key
+        it owned rides powlib's ring-walk failover to the survivor.
+        Idempotent; ``close()`` skips already-killed members."""
+        c = self.coordinators[i]
+        if c is None:
+            return
+        self.coordinators[i] = None
+        c.shutdown()
+
     def close(self) -> None:
         self.client.close()
         for w in self.workers:
             w.shutdown()
         for c in self.coordinators:
-            c.shutdown()
+            if c is not None:
+                c.shutdown()
 
 
 class _CompletionTracker:

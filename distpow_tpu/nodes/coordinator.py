@@ -78,7 +78,7 @@ from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Dict, List, Optional, Tuple
 
 from ..cluster import ClusterService, ClusterState, NotOwnerError, \
-    ring_from_peers
+    Replicator, ring_from_peers
 from ..fleet.membership import FleetRegistry, FleetService, RoundPlan
 from ..parallel.partition import worker_bits as partition_worker_bits
 from ..runtime import actions as act
@@ -322,6 +322,11 @@ class CoordRPCHandler:
         # ids gain this member's namespace, and Mine/Found frames carry
         # the reply-to address shared workers route Results back on.
         self.cluster: Optional[ClusterState] = None
+        #: pool-mode replication engine (cluster/replication.py): every
+        #: accepted cache install is offered for write-behind push to
+        #: the key's ring successors.  None in single-coordinator mode
+        #: — the Result path then runs byte-identical to before.
+        self.replicator = None
         #: this coordinator's WORKER-facing address, stamped into
         #: cluster-mode Mine/Found params as ``coord_addr`` (set by
         #: Coordinator.initialize_rpcs once the listener is bound)
@@ -1339,8 +1344,14 @@ class CoordRPCHandler:
                 # never be installed where a default-model lookup could
                 # replay it — same invariant the worker's Found handler
                 # enforces one hop down
-                self.result_cache.add(nonce, ntz, bytes(params["secret"]),
-                                      trace)
+                installed = self.result_cache.add(
+                    nonce, ntz, bytes(params["secret"]), trace)
+                if installed and self.replicator is not None:
+                    # write-behind replication (cluster/replication.py):
+                    # non-blocking enqueue — a full queue drops and
+                    # counts, never stalls the Result handler
+                    self.replicator.offer(nonce, ntz,
+                                          bytes(params["secret"]))
         entry = self._task_get((nonce, ntz))
         if entry is None:
             # documented fix: the reference blocks forever on a nil channel
@@ -1387,6 +1398,8 @@ class CoordRPCHandler:
             # walks to cover the whole pool
             snap["cluster"] = {"self": self.cluster.self_id,
                                "ring": self.cluster.ring.to_wire()}
+        if self.replicator is not None:
+            snap["replication"] = self.replicator.stats_view()
         snap["sched"] = {
             "max_inflight": self._sched_max_inflight,
             "coalesce": self._coalescer is not None,
@@ -1459,6 +1472,17 @@ class Coordinator:
         self.server.register("Node", StatsOnly(self.handler))
         self.client_addr: Optional[str] = None
         self.worker_addr: Optional[str] = None
+        # cache replication knobs (cluster/replication.py) — only read
+        # when set_cluster_peers actually runs, so single-coordinator
+        # configs never construct a Replicator and stay byte-identical
+        self._repl_replicas = int(getattr(config, "ClusterCacheReplicas", 1))
+        self._repl_queue_depth = int(
+            getattr(config, "ClusterReplQueueDepth", 1024))
+        self._repl_antientropy_s = float(
+            getattr(config, "ClusterAntiEntropyS", 5.0))
+        self._repl_handoff_deadline_s = float(
+            getattr(config, "ClusterHandoffDeadlineS", 5.0))
+        self._replicator: Optional[Replicator] = None
         # coordinator pool (distpow_tpu/cluster/, docs/CLUSTER.md):
         # config-driven membership installs here; ':0'-bound harnesses
         # call set_cluster_peers() once the real addresses exist
@@ -1473,15 +1497,47 @@ class Coordinator:
         register the ``Cluster`` RPC service, and advertise the ring in
         every ``rpc.hello`` ack.  Call before the first Mine; harnesses
         binding on ':0' call it after ``initialize_rpcs`` when the real
-        peer addresses exist (the set_worker_addrs discipline)."""
+        peer addresses exist (the set_worker_addrs discipline).
+
+        Rewiring an already-pooled coordinator is a MEMBERSHIP CHANGE:
+        the ring version bumps (clients adopt strictly newer rings) and
+        the warm shard handoff (cluster/replication.py, docs/CLUSTER.md
+        "Replication & HA") pushes the remapped ranges' entries to
+        their new owners BEFORE the new ring is installed or served —
+        the handoff-before-ack ordering that keeps a grown pool warm.
+        The handoff is deadline-bounded (ClusterHandoffDeadlineS), so a
+        frozen recipient delays the ring change by at most the
+        deadline; anti-entropy heals whatever was cut off."""
         if not (0 <= self_index < len(peers)):
             raise ValueError(
                 f"ClusterSelf={self_index} is not an index into the "
                 f"{len(peers)}-entry ClusterPeers list"
             )
-        state = ClusterState(ring_from_peers(peers), f"c{self_index}")
+        old = self.handler.cluster
+        version = old.ring.version + 1 if old is not None else 0
+        ring = ring_from_peers(peers, version=version)
+        if self._replicator is None:
+            # lazily constructed on first pool join — single-coordinator
+            # processes never reach here, so they carry no replication
+            # threads, queues, or RPCs (byte-identity pin,
+            # tests/test_cluster.py)
+            self._replicator = Replicator(
+                self.handler.result_cache,
+                replicas=self._repl_replicas,
+                queue_depth=self._repl_queue_depth,
+                antientropy_s=self._repl_antientropy_s,
+                handoff_deadline_s=self._repl_handoff_deadline_s,
+            )
+            self.handler.replicator = self._replicator
+        if old is not None and ring != old.ring:
+            # handoff BEFORE install: until this returns (or hits its
+            # deadline) we keep serving and replicating on the old ring
+            self._replicator.handoff(old.ring, ring)
+        state = ClusterState(ring, f"c{self_index}")
+        self._replicator.set_state(state)
         self.handler.set_cluster(state)
-        self.server.register("Cluster", ClusterService(state))
+        self.server.register(
+            "Cluster", ClusterService(state, replicator=self._replicator))
         self.server.hello_extra = state.hello_extra
 
     def set_worker_addrs(self, addrs: List[str]) -> None:
@@ -1523,6 +1579,8 @@ class Coordinator:
 
     def shutdown(self) -> None:
         self.handler.fleet.close()  # stop the lease reaper
+        if self._replicator is not None:
+            self._replicator.close()
         self.server.shutdown()
         for w in list(self.handler.workers):
             if w.client is not None:

@@ -615,7 +615,12 @@ class POW:
           fails the shard is presumed dead and the mine FAILS OVER
           along the ring walk — the sibling serves the foreign key
           over the shared worker fleet; ``cluster.failover_s`` records
-          what the death cost this request.
+          what the death cost this request.  With cache replication on
+          (cluster/replication.py, docs/CLUSTER.md "Replication & HA")
+          the sibling IS the dead owner's ring successor, so a repeat
+          key lands as a dominance-cache hit there — failover serves
+          warm, not a re-mine (scripts/ha_smoke.py pins the trace
+          shape).
         """
         budget = self.retries
         attempt = 0
@@ -667,6 +672,13 @@ class POW:
                     # first owner failure -> successful foreign reply
                     metrics.observe("cluster.failover_s",
                                     time.monotonic() - failover_t0,
+                                    trace_id=trace.trace_id)
+                    # whether the sibling served from its replicated
+                    # cache (warm, the replication plane's promise) or
+                    # re-mined is visible one hop down in the trace;
+                    # mark the serve so ha_smoke/forensics can join on it
+                    RECORDER.record("cluster.failover_served",
+                                    member=member,
                                     trace_id=trace.trace_id)
                 return result
             except _Closed:

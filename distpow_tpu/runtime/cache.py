@@ -24,7 +24,15 @@ appended to a JSONL journal; on construction the journal is replayed
 through the same dominance order, so a restarted node resumes with the
 converged cache state.  Replay tolerates a truncated final line (torn
 write on crash) and compacts the journal when it has accumulated
-superseded entries.
+superseded entries.  Compaction is crash-consistent: the replacement
+journal is written to a temp file, fsynced, atomically renamed over
+the original, and the directory entry is fsynced — a crash at ANY
+point mid-compaction leaves either the complete old journal or the
+complete new one, never a truncated mix (tests/test_runtime.py kills
+compaction mid-write and asserts full replay).  This journal plus the
+restart-epoch file is the coordinator pool's per-member durability
+story (docs/CLUSTER.md "Replication & HA"): a restarted member replays
+its journal, then anti-entropy backfills what it missed while dead.
 """
 
 from __future__ import annotations
@@ -103,6 +111,12 @@ class ResultCache:
         return lines, torn
 
     def _compact(self, path: str) -> None:
+        """Rewrite the journal to the converged entry set, crash-
+        consistently (module docstring): temp file + fsync + atomic
+        rename + directory fsync.  A crash mid-write leaves the
+        original journal untouched; a crash after the rename leaves
+        the complete replacement — no interleaving can shorten the
+        next replay."""
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="ascii") as fh:
             for nonce, e in self._entries.items():
@@ -114,6 +128,17 @@ class ResultCache:
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, path)
+        try:
+            # the rename itself must reach disk, or a crash can resurrect
+            # the superseded journal the replay decision was made against
+            dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                          os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:  # platforms without directory fsync: best effort
+            pass
 
     def _append(self, nonce: bytes, ntz: int, secret: bytes) -> None:
         if self._journal is None:
@@ -225,6 +250,17 @@ class ResultCache:
         """Inspect without tracing (tests/diagnostics)."""
         with self._lock:
             return self._entries.get(bytes(nonce))
+
+    def entries_snapshot(self):
+        """Point-in-time ``[(nonce, ntz, secret), ...]`` copy — the
+        replication plane's iteration surface (cluster/replication.py:
+        handoff range computation, anti-entropy digests).  A snapshot,
+        not a live view: the caller walks it outside the cache lock, so
+        a concurrent add during a handoff costs at most one entry the
+        anti-entropy loop heals later."""
+        with self._lock:
+            return [(n, e.num_trailing_zeros, e.secret)
+                    for n, e in self._entries.items()]
 
     def satisfies(self, nonce: bytes, num_trailing_zeros: int) -> Optional[bytes]:
         """Unmetered, untraced dominance lookup for hot polling paths

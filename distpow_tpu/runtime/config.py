@@ -164,6 +164,27 @@ class CoordinatorConfig:
     # This coordinator's index into ClusterPeers (its ring member id is
     # "c<index>").  Required (>= 0) when ClusterPeers is set.
     ClusterSelf: int = -1
+    # --- cache replication / HA (cluster/replication.py) -----------------
+    # Ring successors each dominance-cache entry is write-behind
+    # replicated to (docs/CLUSTER.md "Replication & HA").  0 disables
+    # the write-behind pushes and anti-entropy (warm handoff on ring
+    # change still runs — it is an ownership-move, not a replica,
+    # concern).  Only meaningful when ClusterPeers is set; single
+    # coordinators never replicate.
+    ClusterCacheReplicas: int = 1
+    # Bound on the write-behind push queue: replication stays off the
+    # Mine critical path, so a slower-than-traffic successor overflows
+    # the queue and the overflow is DROPPED (counted in
+    # repl.push_failures; anti-entropy heals it later).
+    ClusterReplQueueDepth: int = 1024
+    # Anti-entropy cadence (seconds): each sweep exchanges per-ring-
+    # range digests with the successors and pushes only diverged
+    # ranges.  0 = off.
+    ClusterAntiEntropyS: float = 5.0
+    # Bound on one warm shard handoff (seconds): a frozen recipient
+    # costs at most this before the ring change proceeds without it
+    # (anti-entropy backfills what the deadline cut off).
+    ClusterHandoffDeadlineS: float = 5.0
 
 
 @dataclass

@@ -108,6 +108,22 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
 * ``cluster.sibling_hedges`` — RETRY_AFTER rejections hedged to the
   next ring sibling instead of waiting out the owner's hint
   (non-counting, like every server-paced retry)
+* ``repl.pushes`` — dominance-cache entries write-behind-pushed to a
+  ring successor (one per entry-destination pair;
+  distpow_tpu/cluster/replication.py, docs/CLUSTER.md "Replication")
+* ``repl.push_failures`` — entries dropped from the bounded push queue
+  or lost to a failed ``Cluster.CacheSync`` (anti-entropy heals both)
+* ``repl.installs`` — replica-side installs accepted through the
+  dominance order (CacheSync pushes, handoff chunks, anti-entropy
+  heals alike)
+* ``repl.stale_drops`` — replica-side pushes REJECTED by the dominance
+  order (a stale lower-ntz push after a higher-ntz install — proof the
+  order held, never a regression)
+* ``repl.handoff_keys`` — entries pushed to their new owner during a
+  warm shard handoff (``Cluster.Handoff``, before the ring change is
+  acked)
+* ``repl.antientropy_rounds`` — anti-entropy digest-exchange sweeps
+  completed against the ring successors
 
 Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 ``KNOWN_HISTOGRAM_PREFIXES`` vs ``observe()``/``time()`` call sites):
@@ -128,6 +144,11 @@ Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 * ``cluster.failover_s`` — first owner-shard transport failure to the
   successful reply from another shard: the client-observed cost of
   riding out a coordinator death (nodes/powlib.py, docs/CLUSTER.md)
+* ``repl.push_lag_s`` — round completion (queue admit) to the entry
+  landing on its last ring successor: the replication window a member
+  death can lose (distpow_tpu/cluster/replication.py)
+* ``repl.handoff_s`` — wall time of one warm shard handoff (all
+  targets, chunked sends, deadline-bounded)
 * ``worker.time_to_cancel_s`` — Mine receipt to honored cancellation
 * ``search.launch_s``  — time blocked fetching one launch's result
   (the serial driver's FIFO drain; parallel/search.py)
@@ -197,6 +218,8 @@ KNOWN_COUNTERS = frozenset({
     "cluster.not_owner_redirects", "cluster.foreign_mines",
     "cluster.ring_serves",
     "cluster.reroutes", "cluster.failovers", "cluster.sibling_hedges",
+    "repl.pushes", "repl.push_failures", "repl.installs",
+    "repl.stale_drops", "repl.handoff_keys", "repl.antientropy_rounds",
 })
 
 # Families minted from runtime values (f-string call sites): the
@@ -219,6 +242,7 @@ KNOWN_HISTOGRAMS = frozenset({
     "obs.sweep_s",
     "fleet.heartbeat_rtt_s",
     "cluster.failover_s",
+    "repl.push_lag_s", "repl.handoff_s",
 })
 
 # Per-method families (runtime/rpc.py mints one histogram per

@@ -89,6 +89,12 @@ METHODS: Tuple[str, ...] = (
     # docs/CLUSTER.md): the ring snapshot on demand; table stays
     # append-only
     "Cluster.Ring",
+    # appended for the cache replication plane
+    # (distpow_tpu/cluster/replication.py, docs/CLUSTER.md
+    # "Replication & HA"): write-behind/anti-entropy pushes and the
+    # warm shard handoff; table stays append-only
+    "Cluster.CacheSync",
+    "Cluster.Handoff",
 )
 _METHOD_IDS = {m: i for i, m in enumerate(METHODS)}
 
@@ -136,6 +142,14 @@ KEYS: Tuple[str, ...] = (
     "coord_addr",
     "no_redirect",
     "self",
+    # appended for the cache replication plane
+    # (distpow_tpu/cluster/replication.py): CacheSync/Handoff entry
+    # batches, the anti-entropy digest exchange, and the install
+    # accounting replies; table stays append-only
+    "entries",
+    "digest",
+    "installed",
+    "stale",
 )
 _KEY_IDS = {k: i for i, k in enumerate(KEYS)}
 

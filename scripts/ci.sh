@@ -47,6 +47,13 @@
 # one seed to the whole pool, then one shard SIGKILLed mid-load —
 # zero client-visible Mine errors via ring failover, and trace_check
 # must still report 0 violations — ~20 s, CPU, no jax.
+# `--ha-smoke` runs the replicated-dominance-cache crash/restart gate
+# (scripts/ha_smoke.py, docs/CLUSTER.md "Replication & HA"): a REAL
+# 2-process coordinator pool with write-behind replication on, one
+# member SIGKILLed mid-load — the survivor must serve the dead
+# member's repeat keys from its REPLICATED cache (hits, zero fan-outs,
+# zero client errors), and the restarted member must rejoin warm from
+# its journal — ~30 s, CPU, no jax.
 # `--forensics-smoke` runs the request-forensics smoke
 # (scripts/forensics_smoke.py, docs/FORENSICS.md): a REAL 3-process
 # cluster (coordinator + 2 workers, one delayed by the PR 1 fault
@@ -54,7 +61,7 @@
 # Node.Spans sweep must stitch a timeline naming the delayed worker's
 # shard; trace_check must still report 0 violations — ~15 s, CPU,
 # no jax.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke]
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -124,6 +131,13 @@ if [ "${1:-}" = "--cluster-smoke" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "--ha-smoke" ]; then
+  echo "=== cache-HA smoke (replicated cache + SIGKILL + warm restart) ==="
+  JAX_PLATFORMS=cpu python scripts/ha_smoke.py
+  echo "=== ha smoke OK ==="
+  exit 0
+fi
+
 if [ "${1:-}" = "--forensics-smoke" ]; then
   echo "=== forensics smoke (3-process cluster + delayed worker + stitched timeline) ==="
   JAX_PLATFORMS=cpu python scripts/forensics_smoke.py
@@ -169,7 +183,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]" >&2
           exit 2 ;;
 esac
 

@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""Replicated-dominance-cache HA smoke (ISSUE 16, docs/CLUSTER.md
+"Replication & HA"; ci.sh --ha-smoke).
+
+The crash/restart acceptance gate, end to end, on a REAL multi-process
+pool — separate OS processes over localhost RPC, killed with a real
+SIGKILL, not an in-process shutdown():
+
+1. ``config_gen --coordinators 2`` emits the pool configs (replication
+   on by default: ``ClusterCacheReplicas=1``); per-member cache
+   journals + a fast anti-entropy cadence are wired in; boot tracing
+   server + BOTH coordinators + 2 python-backend workers;
+2. WARM a key set spanning both shards, then wait until write-behind
+   replication has converged (each member's ``cache_entries`` covers
+   the full key set: its own shard plus the other member's replicas —
+   polled via ``Node.Stats``);
+3. SIGKILL coordinator 1 MID-LOAD (half the repeat wave in flight) and
+   re-mine every warmed key: ZERO client-visible errors, and the
+   survivor serves the dead member's repeat keys from its REPLICATED
+   dominance cache — its ``cache.hit`` ticks once per repeat while
+   ``coord.fanouts`` stays FLAT (no re-mine), and the trace stream
+   carries the CacheHit shape;
+4. RESTART the dead member: it replays its journal (warm rejoin) and
+   serves its own repeat keys as cache hits with ``coord.fanouts``
+   still at zero in the fresh process — no re-mine on restart;
+5. ``trace_check`` over the tracing server's logs must report
+   0 violations — replication traffic is invisible to the 16-action
+   trace vocabulary.
+
+Prints one JSON summary line on stdout (details to stderr); exits 0
+only when every gate held.  ~30 s, pure CPU, no jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distpow_tpu.cluster import ring_from_peers  # noqa: E402
+from distpow_tpu.nodes import Client  # noqa: E402
+from distpow_tpu.runtime.config import (  # noqa: E402
+    ClientConfig,
+    CoordinatorConfig,
+    read_json_config,
+)
+from distpow_tpu.runtime.rpc import RPCClient  # noqa: E402
+
+WARM_NTZ = 2   # warmed difficulty; repeats at ntz=1 are dominated
+N_KEYS = 12
+
+
+def gate(name, ok, detail=""):
+    print(f"[ha-smoke] {'PASS' if ok else 'FAIL'}: {name}"
+          f"{' — ' + detail if detail else ''}", file=sys.stderr)
+    if not ok:
+        sys.exit(1)
+
+
+def wait_rpc(addr: str, method: str, timeout_s: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            c = RPCClient(addr, timeout=1.0)
+            try:
+                c.call(method, {}, timeout=2.0)
+                return
+            finally:
+                c.close()
+        except Exception as exc:  # readiness probe: any failure retries
+            last = exc
+            time.sleep(0.1)
+    raise AssertionError(f"{addr} never answered {method}: {last}")
+
+
+def node_stats(addr: str) -> dict:
+    c = RPCClient(addr, timeout=2.0)
+    try:
+        return c.call("Node.Stats", {}, timeout=5.0)
+    finally:
+        c.close()
+
+
+def counter(snap: dict, name: str) -> int:
+    return int((snap.get("counters") or {}).get(name, 0))
+
+
+def drain(notify, n, timeout_s=120.0):
+    got, errors = [], []
+    deadline = time.monotonic() + timeout_s
+    while len(got) < n and time.monotonic() < deadline:
+        try:
+            res = notify.get(timeout=0.5)
+        except Exception:
+            continue
+        got.append(res)
+        if res.error:
+            errors.append(str(res.error))
+    return got, errors
+
+
+def main() -> int:
+    # same port-collision re-roll discipline as cluster_smoke.py
+    for attempt in (1, 2):
+        try:
+            return _run()
+        except AssertionError as exc:
+            if attempt == 2:
+                raise
+            print(f"[ha-smoke] boot attempt {attempt} failed "
+                  f"({exc}); re-rolling ports", file=sys.stderr)
+    return 1
+
+
+def _run() -> int:
+    procs = {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+
+    def spawn(name, *argv):
+        p = subprocess.Popen(
+            [sys.executable, *argv], cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs[name] = p
+        return p
+
+    with tempfile.TemporaryDirectory() as td:
+        subprocess.run(
+            [sys.executable, "-m", "distpow_tpu.cli.config_gen",
+             "--config-dir", td, "--workers", "2", "--coordinators", "2"],
+            cwd=REPO, env=env, check=True, capture_output=True,
+        )
+        wcfg_path = os.path.join(td, "worker_config.json")
+        wcfg = json.loads(open(wcfg_path).read())
+        wcfg["Backend"] = "python"
+        open(wcfg_path, "w").write(json.dumps(wcfg))
+        ts_path = os.path.join(td, "tracing_server_config.json")
+        ts_cfg = json.loads(open(ts_path).read())
+        ts_cfg["OutputFile"] = os.path.join(td, "trace_output.log")
+        ts_cfg["ShivizOutputFile"] = os.path.join(td, "shiviz_output.log")
+        open(ts_path, "w").write(json.dumps(ts_cfg))
+        # durability + fast reconciliation: per-member cache journals
+        # (the restart-warm story) and a sub-second anti-entropy cadence
+        # so the restarted member backfills quickly
+        coord_paths = [os.path.join(td, "coordinator_config.json"),
+                       os.path.join(td, "coordinator1_config.json")]
+        for i, p in enumerate(coord_paths):
+            cfg = json.loads(open(p).read())
+            cfg["CacheFile"] = os.path.join(td, f"cache.c{i}.jsonl")
+            cfg["ClusterAntiEntropyS"] = 0.5
+            open(p, "w").write(json.dumps(cfg))
+        coord0 = read_json_config(coord_paths[0], CoordinatorConfig)
+        coord1 = read_json_config(coord_paths[1], CoordinatorConfig)
+        client_cfg = read_json_config(
+            os.path.join(td, "client_config.json"), ClientConfig)
+        gate("config_gen emitted the pool with replication on",
+             coord0.ClusterPeers == coord1.ClusterPeers
+             and coord0.ClusterSelf == 0 and coord1.ClusterSelf == 1
+             and coord0.ClusterCacheReplicas == 1
+             and coord0.CacheFile != coord1.CacheFile,
+             f"ring seeds {coord0.ClusterPeers}")
+        c0_addr, c1_addr = client_cfg.CoordAddrs
+
+        try:
+            spawn("tracer", "-m", "distpow_tpu.cli.tracing_server",
+                  "--config", ts_path)
+            time.sleep(0.5)
+            spawn("coord0", "-m", "distpow_tpu.cli.coordinator",
+                  "--config", coord_paths[0])
+            spawn("coord1", "-m", "distpow_tpu.cli.coordinator",
+                  "--config", coord_paths[1])
+            # workers dial their coordinator EAGERLY at boot; wait for
+            # both members' listeners before spawning them so the smoke
+            # never flakes on the boot race
+            for addr in client_cfg.CoordAddrs:
+                wait_rpc(addr, "Node.Stats")
+            for i, addr in enumerate(coord0.Workers):
+                spawn(f"worker{i + 1}", "-m", "distpow_tpu.cli.worker",
+                      "--config", wcfg_path, "--id", f"worker{i + 1}",
+                      "--listen", addr)
+            for addr in coord0.Workers:
+                wait_rpc(addr, "WorkerRPCHandler.Ping")
+            gate("real 2-coordinator pool up", True,
+                 f"shards at {client_cfg.CoordAddrs}")
+
+            client = Client(ClientConfig(
+                ClientID="hasmoke",
+                CoordAddr=client_cfg.CoordAddr,
+                CoordAddrs=list(client_cfg.CoordAddrs),
+                TracerServerAddr=ts_cfg["ServerBind"],
+                ChCapacity=256,
+                MineRetries=8, MineBackoffS=0.05, MineBackoffMaxS=0.4,
+            ))
+            client.initialize()
+            ring = ring_from_peers(client_cfg.CoordAddrs)
+            try:
+                # -- phase 1: warm a key set spanning both shards -----
+                keys = [bytes([i, 31]) for i in range(N_KEYS)]
+                by_owner = {"c0": [], "c1": []}
+                for x in keys:
+                    by_owner[ring.owner(x)].append(x)
+                gate("warm set spans both shards",
+                     by_owner["c0"] and by_owner["c1"],
+                     f"c0={len(by_owner['c0'])} c1={len(by_owner['c1'])}")
+                for x in keys:
+                    client.mine(x, WARM_NTZ)
+                got, errors = drain(client.notify_queue, len(keys))
+                gate("warm phase: all mines complete",
+                     len(got) == len(keys) and not errors,
+                     f"{len(got)}/{len(keys)}, errors={errors[:2]}")
+
+                # -- phase 2: replication converged -------------------
+                # each member must HOLD every key (its own shard plus
+                # the other member's replicas): gate on actual cache
+                # presence, not repl.installs — install counters can
+                # overshoot (multiple worker Results per key) and would
+                # pass while keys are still missing
+                deadline = time.monotonic() + 30.0
+                conv = (0, 0)
+                while time.monotonic() < deadline:
+                    conv = (int(node_stats(c0_addr)
+                                .get("cache_entries", 0)),
+                            int(node_stats(c1_addr)
+                                .get("cache_entries", 0)))
+                    if conv[0] >= N_KEYS and conv[1] >= N_KEYS:
+                        break
+                    time.sleep(0.2)
+                gate("write-behind replication converged",
+                     conv[0] >= N_KEYS and conv[1] >= N_KEYS,
+                     f"cache_entries c0={conv[0]} c1={conv[1]} "
+                     f"(want {N_KEYS} each: own shard + replicas)")
+
+                # -- phase 3: SIGKILL the owner mid-load --------------
+                # wave order matters for the survivor-hit arithmetic:
+                # the pre-kill half is the SURVIVOR's shard (in flight
+                # when the kill lands), the post-kill half is the dead
+                # member's keys — every one of those must fail over and
+                # hit c0's replica, so the survivor's cache.hit delta
+                # deterministically covers the full wave
+                s0 = node_stats(c0_addr)
+                pre_hits = counter(s0, "cache.hit")
+                pre_fanouts = counter(s0, "coord.fanouts")
+                for x in by_owner["c0"]:
+                    client.mine(x, 1)  # dominated repeats
+                procs["coord1"].send_signal(signal.SIGKILL)
+                procs["coord1"].wait(timeout=10)
+                for x in by_owner["c1"]:
+                    client.mine(x, 1)
+                got, errors = drain(client.notify_queue, len(keys))
+                gate("SIGKILL mid-load: zero client-visible errors",
+                     len(got) == len(keys) and not errors,
+                     f"{len(got)}/{len(keys)}, errors={errors[:2]}")
+                s0 = node_stats(c0_addr)
+                d_hits = counter(s0, "cache.hit") - pre_hits
+                d_fanouts = counter(s0, "coord.fanouts") - pre_fanouts
+                gate("survivor served every repeat from cache "
+                     "(dead member's keys included)",
+                     d_hits >= len(keys), f"{d_hits} hits/{len(keys)}")
+                gate("zero re-mines on the survivor",
+                     d_fanouts == 0, f"{d_fanouts} fan-outs")
+
+                # -- phase 4: restart the member; warm rejoin ---------
+                spawn("coord1b", "-m", "distpow_tpu.cli.coordinator",
+                      "--config", coord_paths[1])
+                wait_rpc(c1_addr, "Node.Stats")
+                s1 = node_stats(c1_addr)
+                gate("restarted member replayed its journal",
+                     int(s1.get("cache_entries", 0))
+                     >= len(by_owner["c1"]),
+                     f"{s1.get('cache_entries')} entries "
+                     f"(want >= {len(by_owner['c1'])})")
+                pre_hits1 = counter(s1, "cache.hit")
+                pre_fanouts1 = counter(s1, "coord.fanouts")
+                for x in by_owner["c1"]:
+                    client.mine(x, 1)
+                got, errors = drain(client.notify_queue,
+                                    len(by_owner["c1"]))
+                gate("post-restart repeats: zero client errors",
+                     len(got) == len(by_owner["c1"]) and not errors,
+                     f"{len(got)}/{len(by_owner['c1'])}, "
+                     f"errors={errors[:2]}")
+                s1 = node_stats(c1_addr)
+                d_hits1 = counter(s1, "cache.hit") - pre_hits1
+                d_fanouts1 = counter(s1, "coord.fanouts") - pre_fanouts1
+                gate("rejoined member serves its own keys WARM "
+                     "(no re-mine after restart)",
+                     d_hits1 >= len(by_owner["c1"]) and d_fanouts1 == 0,
+                     f"{d_hits1} hits, {d_fanouts1} fan-outs")
+            finally:
+                client.close()
+
+            # -- trace-plane invariants + the CacheHit shape ----------
+            time.sleep(1.0)  # let the tracing server flush its logs
+            trace_text = open(ts_cfg["OutputFile"], errors="replace") \
+                .read()
+            gate("trace stream carries the CacheHit shape",
+                 trace_text.count("CacheHit") >= N_KEYS,
+                 f"{trace_text.count('CacheHit')} CacheHit actions")
+            chk = subprocess.run(
+                [sys.executable, "-m", "distpow_tpu.cli.trace_check",
+                 ts_cfg["OutputFile"], ts_cfg["ShivizOutputFile"]],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=60,
+            )
+            gate("trace_check: 0 violations", chk.returncode == 0,
+                 (chk.stdout + chk.stderr).strip().splitlines()[-1]
+                 if (chk.stdout + chk.stderr).strip() else "")
+
+            print(json.dumps({
+                "metric": "cache-HA smoke: warm pool, SIGKILL mid-load "
+                          "served from replicas, warm restart rejoin",
+                "keys": N_KEYS,
+                "survivor_repeat_hits": d_hits,
+                "survivor_fanouts": d_fanouts,
+                "rejoin_repeat_hits": d_hits1,
+                "pool": client_cfg.CoordAddrs,
+                "ok": True,
+            }))
+            return 0
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.send_signal(signal.SIGKILL)
+            for p in procs.values():
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

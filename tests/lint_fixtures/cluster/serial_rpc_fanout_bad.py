@@ -1,0 +1,23 @@
+"""Fixture: serial-rpc-fanout must fire in cluster/ too (ISSUE 16) —
+the replication plane loops over peers with RPCs inside, and a serial
+push loop that is NOT the bounded background pusher is the same
+head-of-line-blocking bug as a serial round start (3 findings)."""
+
+
+def push_to_all_peers(self, peers, entries):
+    replies = {}
+    for p in peers:
+        replies[p.member] = p.client.call(
+            "Cluster.CacheSync", {"entries": entries})  # 1
+    return replies
+
+
+def digest_walk(successor_targets):
+    for t in successor_targets:
+        t.call("Cluster.CacheSync", {"digest": 32}, timeout=2.0)  # 2
+
+
+def nested_handoff(target_groups):
+    for group in target_groups:
+        for t in group:
+            t.call("Cluster.Handoff", {})  # 3 (nested loop, same scope)

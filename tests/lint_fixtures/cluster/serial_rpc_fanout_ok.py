@@ -1,0 +1,31 @@
+"""Fixture: the sanctioned replication-plane shapes must not trip
+serial-rpc-fanout in cluster/."""
+
+import subprocess
+
+
+def futures_then_await(peers, entries):
+    # the sanctioned fan-out: issue every push, then await under one
+    # shared deadline
+    futs = [p.client.go("Cluster.CacheSync", {"entries": entries})
+            for p in peers]
+    for fut in futs:
+        fut.result(timeout=5.0)
+
+
+def suppressed_background_pusher(targets, batch):
+    # the write-behind pusher's shape: deliberately serial, justified
+    # at the call site — the suppression protocol the real
+    # cluster/replication.py push loop follows
+    for t in sorted(targets):
+        t.client.call("Cluster.CacheSync", batch, timeout=5.0)  # distpow: ok serial-rpc-fanout -- fixture: deliberately serial single background pusher, bounded by the replica count and the per-call timeout
+
+
+def not_a_peer_collection(chunks):
+    for chunk in chunks:
+        chunk.sink.call("Cluster.Handoff", chunk.entries)
+
+
+def subprocess_is_not_rpc(node_cmds):
+    for cmd in node_cmds:
+        subprocess.call(cmd)

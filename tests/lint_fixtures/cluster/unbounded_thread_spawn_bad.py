@@ -1,0 +1,23 @@
+"""Fixture: unbounded-thread-spawn must fire in cluster/ (ISSUE 16) —
+the replication plane's tempting shapes all spawn per item: a thread
+per pushed entry, a thread per digest exchange, a thread per handoff
+chunk (3 findings)."""
+
+import threading
+from threading import Thread
+
+
+def push_each_entry(entries, peers):
+    for e in entries:  # one thread per cache entry: scales with cache
+        threading.Thread(target=peers.push, args=(e,)).start()
+
+
+def antientropy_forever(ring):
+    while True:  # one thread per sweep: scales with uptime
+        Thread(target=ring.sweep).start()
+
+
+def nested_chunk_senders(target_chunks):
+    for chunk in target_chunks:
+        for c in chunk:  # anchors to THIS (innermost) loop only
+            threading.Thread(target=c.send).start()

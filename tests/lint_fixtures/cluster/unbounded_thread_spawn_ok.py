@@ -1,0 +1,34 @@
+"""Fixture: the sanctioned replication-plane shapes must stay clean."""
+
+import threading
+
+
+def persistent_pusher(queue_):
+    def drain():
+        while True:
+            batch = queue_.get()
+            batch.push()
+
+    # ONE pusher thread outside the loop; the loop lives inside it —
+    # the real write-behind pusher's shape (cluster/replication.py)
+    threading.Thread(target=drain, daemon=True).start()
+
+
+def sender_defined_in_loop(targets):
+    senders = []
+    for t in targets:
+        # a closure DEFINED (not started) per target is outside the
+        # loop's dynamic extent
+        def send(t=t):
+            threading.Thread(target=t.push).start()
+
+        senders.append(send)
+    return senders
+
+
+def suppressed_handoff_senders(moved, deadline):
+    # the warm-handoff sender's shape: one spawn per NEW owner of a
+    # remapped range, justified at the spawn site — the suppression
+    # protocol the real cluster/replication.py handoff follows
+    for target, entries in sorted(moved.items()):
+        threading.Thread(target=entries.send, args=(deadline,)).start()  # distpow: ok unbounded-thread-spawn -- fixture: bounded by the pool size (one spawn per new owner) and the shared handoff deadline

@@ -643,3 +643,76 @@ def test_finalize_forensics_only_attaches_cluster_scale(bench):
     assert line["unit"] == "x"
     assert "spans+exemplars" in line["metric"]
     assert line["cluster_scale"] == CS
+
+# -- cache-HA stage (ISSUE 16) -------------------------------------------------
+
+CH = {
+    "warm_ntz": 2, "n_keys": 12,
+    "arms": {
+        "repl_on": {"replicas": 1, "keys": 12, "dead_owned": 6,
+                    "warm_completed": 12, "warm_errors": 0,
+                    "converged": True, "repeat_completed": 12,
+                    "repeat_errors": 0, "repeat_hits": 12,
+                    "repeat_fanouts": 0, "repeat_hit_ratio": 1.0},
+        "repl_off": {"replicas": 0, "keys": 12, "dead_owned": 6,
+                     "warm_completed": 12, "warm_errors": 0,
+                     "converged": True, "repeat_completed": 12,
+                     "repeat_errors": 0, "repeat_hits": 6,
+                     "repeat_fanouts": 6, "repeat_hit_ratio": 0.5},
+    },
+    "hit_ratio_on": 1.0, "hit_ratio_off": 0.5, "on_vs_off_x": 2.0,
+    "ok": True, "wall_s": 2.3,
+}
+
+
+def test_finalize_attaches_cache_ha_row(bench):
+    """The cache-HA stage rides both artifacts of a normal run, like
+    the other tunnel-independent rows."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_FULL, 5.35e6, cache_ha=CH
+    )
+    assert line["cache_ha"] == CH
+    assert prov["cache_ha"] == CH
+    assert line["unit"] == "MH/s"
+
+
+def test_finalize_cache_ha_only_run(bench):
+    """bench.py --cache-ha: the headline is the replication-on repeat
+    hit ratio (vs_baseline the on/off gap) and kernel provenance is
+    NOT re-stamped."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None, cache_ha=CH)
+    assert prov is None
+    assert line["unit"] == "ratio"
+    assert line["value"] == 1.0
+    assert line["vs_baseline"] == 2.0
+    assert "replication on" in line["metric"]
+    assert line["cache_ha"] == CH
+
+
+def test_finalize_carries_forward_cache_ha(bench):
+    lm = dict(LAST_FULL, cache_ha=CH)
+    line, prov = bench.finalize_record({"serving": 9800.0e6}, lm, 5.35e6)
+    assert prov["cache_ha"] == CH
+    assert "cache_ha" not in line
+
+
+def test_finalize_control_plane_headline_attaches_cache_ha(bench):
+    """Device-unreachable runs that measured both CPU stages: the
+    control-plane row stays the headline, cache-HA rides along."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, control_plane=CP, cache_ha=CH
+    )
+    assert prov is None
+    assert line["unit"] == "ms"
+    assert line["cache_ha"] == CH
+
+
+def test_finalize_cluster_scale_only_attaches_cache_ha(bench):
+    """A cluster-scale-headline run still carries the cache-HA dict."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, cluster_scale=CS, cache_ha=CH
+    )
+    assert prov is None
+    assert line["unit"] == "x"
+    assert "4-coordinator pool" in line["metric"]
+    assert line["cache_ha"] == CH

@@ -692,6 +692,396 @@ def test_cluster_ring_rpc_and_invalid_self_rejected():
         ))
 
 
+# -- cache replication / HA (ISSUE 16, docs/CLUSTER.md "Replication & HA") ---
+
+def _wait_for(pred, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_write_behind_replicates_entry_to_ring_successor():
+    """The tentpole's core promise: a round completed at the owner
+    lands on the key's ring successor via the write-behind push —
+    observable as the SIBLING's dominance cache holding the entry."""
+    cluster = _pool()
+    try:
+        ring = cluster.client.pow._ring
+        nonce = _nonce_owned_by(ring, "c1", tag=20)
+        sibling = cluster.coordinators[0].handler.result_cache
+        assert sibling.peek(nonce) is None
+        before = metrics.get("repl.installs")
+        _mine_ok(cluster, nonce, 2)
+        # the push is write-BEHIND: off the Mine path, so the entry
+        # arrives shortly after, not synchronously with, the reply
+        _wait_for(lambda: sibling.peek(nonce) is not None,
+                  what="replica install on the sibling")
+        entry = sibling.peek(nonce)
+        assert entry.num_trailing_zeros >= 2
+        assert puzzle.check_secret(nonce, entry.secret, 2)
+        assert metrics.get("repl.installs") > before
+        snap = metrics.snapshot()["histograms"].get("repl.push_lag_s")
+        assert snap and snap["count"] >= 1
+    finally:
+        cluster.close()
+
+
+def test_survivor_serves_dead_members_repeat_key_from_replica():
+    """The HA acceptance gate's in-process half (scripts/ha_smoke.py
+    does the real-SIGKILL version): kill the owner AFTER its entry
+    replicated — the repeat key rides ring-walk failover to the
+    survivor and is served from the REPLICATED dominance cache (a
+    CacheHit, not a re-mine)."""
+    cluster = _pool(client_extra={"MineBackoffS": 0.05,
+                                  "MineBackoffMaxS": 0.3})
+    try:
+        ring = cluster.client.pow._ring
+        nonce = _nonce_owned_by(ring, "c1", tag=21)
+        survivor = cluster.coordinators[0].handler.result_cache
+        _mine_ok(cluster, nonce, 2)
+        _wait_for(lambda: survivor.peek(nonce) is not None,
+                  what="replica install on the survivor")
+        cluster.kill_coordinator(1)  # the OWNER dies
+        before_hits = metrics.get("cache.hit")
+        before_fanouts = metrics.get("coord.fanouts")
+        t0 = time.monotonic()
+        _mine_ok(cluster, nonce, 1)  # dominated by the replicated ntz=2
+        wall = time.monotonic() - t0
+        assert metrics.get("cache.hit") > before_hits
+        # served warm: the survivor never fanned a mining round out
+        assert metrics.get("coord.fanouts") == before_fanouts
+        assert wall < 10.0
+    finally:
+        cluster.close()
+
+
+def test_stale_push_is_dropped_not_regressed():
+    """Dominance under replication: a push carrying FEWER trailing
+    zeros than the replica already holds is rejected by the same
+    order every install rides — counted as repl.stale_drops, and the
+    replica's entry is untouched."""
+    from distpow_tpu.cluster import Replicator, entry_wire
+    from distpow_tpu.runtime.cache import ResultCache
+
+    cache = ResultCache()
+    cache.add(b"\xaa\x01", 5, b"high-secret", trace=None)
+    repl = Replicator(cache, replicas=0)  # install path needs no threads
+    before_stale = metrics.get("repl.stale_drops")
+    before_inst = metrics.get("repl.installs")
+    installed, stale = repl.install([
+        entry_wire(b"\xaa\x01", 3, b"late-low"),   # stale: lower ntz
+        entry_wire(b"\xaa\x02", 4, b"fresh"),      # new key: installs
+    ])
+    assert (installed, stale) == (1, 1)
+    assert cache.peek(b"\xaa\x01").secret == b"high-secret"
+    assert cache.peek(b"\xaa\x01").num_trailing_zeros == 5
+    assert cache.peek(b"\xaa\x02").num_trailing_zeros == 4
+    assert metrics.get("repl.stale_drops") == before_stale + 1
+    assert metrics.get("repl.installs") == before_inst + 1
+    repl.close()
+
+
+def test_push_queue_overflow_drops_and_counts():
+    """The write-behind queue is BOUNDED: overflow is a counted drop
+    (anti-entropy heals it later), never backpressure into the Result
+    handler."""
+    from distpow_tpu.cluster import Replicator
+    from distpow_tpu.runtime.cache import ResultCache
+
+    repl = Replicator(ResultCache(), replicas=1, queue_depth=1)
+    # state installed directly so no pusher thread drains the queue
+    repl._state = ClusterState(ring_from_peers(["a:1", "b:2"]), "c0")
+    before = metrics.get("repl.push_failures")
+    assert repl.offer(b"\x01", 1, b"s1") is True
+    assert repl.offer(b"\x02", 1, b"s2") is False  # queue full: dropped
+    assert metrics.get("repl.push_failures") == before + 1
+    repl.close()
+
+
+def test_antientropy_heals_entry_missed_by_write_behind():
+    """A replica that was down (or a dropped push) misses write-behind
+    traffic; the digest exchange finds the diverged range and heals
+    exactly it.  The sweep is invoked directly — deterministic, no
+    interval sleeps — with the pool's timer loop disabled."""
+    cluster = _pool(coord_extra={"ClusterAntiEntropyS": 0.0})
+    try:
+        ring = cluster.client.pow._ring
+        owner = cluster.coordinators[1]
+        sibling_cache = cluster.coordinators[0].handler.result_cache
+        # install at the owner BEHIND the replication plane's back —
+        # the stand-in for an entry whose push was lost
+        nonce = _nonce_owned_by(ring, "c1", tag=22)
+        owner.handler.result_cache.add(nonce, 3, b"healed-secret",
+                                       trace=None)
+        assert sibling_cache.peek(nonce) is None
+        before_rounds = metrics.get("repl.antientropy_rounds")
+        healed = owner._replicator.antientropy_sweep()
+        assert healed >= 1
+        entry = sibling_cache.peek(nonce)
+        assert entry is not None and entry.secret == b"healed-secret"
+        assert metrics.get("repl.antientropy_rounds") == before_rounds + 1
+        # convergence: the next sweep finds nothing to heal
+        assert owner._replicator.antientropy_sweep() == 0
+    finally:
+        cluster.close()
+
+
+def _handoff_rig(peers_old, peers_new, sender_id, receiver_ids):
+    """Real-RPC handoff rig: one listening receiver per new owner,
+    each with its own cache + install-path Replicator; the sender is a
+    thread-less Replicator over a pre-populated cache."""
+    from distpow_tpu.cluster import ClusterService, Replicator
+    from distpow_tpu.runtime.cache import ResultCache
+
+    receivers = {}
+    addr_by_id = dict(peers_new)
+    for rid in receiver_ids:
+        server = rpc.RPCServer()
+        cache = ResultCache()
+        repl = Replicator(cache, replicas=0)
+        addr = server.listen("127.0.0.1:0")
+        addr_by_id[rid] = addr
+        server.serve_in_background()
+        receivers[rid] = (server, cache, repl)
+    old_ring = HashRing([(m, addr_by_id.get(m, a))
+                         for m, a in peers_old])
+    new_ring = HashRing([(m, addr_by_id.get(m, a)) for m, a in peers_new],
+                        version=1)
+    for rid, (server, cache, repl) in receivers.items():
+        state = ClusterState(new_ring, rid)
+        repl._state = state
+        server.register("Cluster", ClusterService(state, replicator=repl))
+    sender_cache = ResultCache()
+    sender = Replicator(sender_cache, replicas=0)
+    sender._state = ClusterState(old_ring, sender_id)
+    return old_ring, new_ring, sender, sender_cache, receivers
+
+
+def test_handoff_grow_moves_exactly_the_remapped_keys():
+    """Warm handoff property, N -> N+1: exactly the keys whose owner
+    changed from the sender to the NEW member arrive there — every one
+    of them, and nothing else."""
+    peers_old = [("c0", "o0:1"), ("c1", "o1:1")]
+    peers_new = [("c0", "o0:1"), ("c1", "o1:1"), ("c2", None)]
+    old_ring, new_ring, sender, sender_cache, receivers = _handoff_rig(
+        peers_old, peers_new, "c0", ["c2"])
+    try:
+        nonces = _sample_nonces(600)
+        for i, n in enumerate(nonces):
+            if old_ring.owner(n) == "c0":
+                sender_cache.add(n, 1 + i % 3, b"s%d" % i, trace=None)
+        moved = {n for n, _z, _s in sender_cache.entries_snapshot()
+                 if new_ring.owner(n) == "c2"}
+        assert moved, "fixture must remap at least one key"
+        result = sender.handoff(old_ring, new_ring, deadline_s=20.0)
+        assert result["complete"] is True
+        assert result["keys"] == result["expected"] == len(moved)
+        _server, recv_cache, _repl = receivers["c2"]
+        arrived = {n for n, _z, _s in recv_cache.entries_snapshot()}
+        assert arrived == moved  # every remapped key, nothing else
+        # and each arrived entry carries the sender's exact payload
+        for n in moved:
+            assert recv_cache.peek(n).secret == sender_cache.peek(n).secret
+    finally:
+        sender.close()
+        for server, _c, repl in receivers.values():
+            repl.close()
+            server.shutdown()
+
+
+def test_handoff_shrink_moves_all_leaving_members_keys_to_survivors():
+    """Warm handoff property, N+1 -> N: the LEAVING member's whole key
+    range lands on the survivors the new ring assigns — partitioned
+    exactly, nothing misdelivered, dominance preserved when a survivor
+    already holds a better entry (counted as repl.stale_drops)."""
+    peers_old = [("c0", None), ("c1", None), ("c2", "gone:1")]
+    peers_new = [("c0", None), ("c1", None)]
+    old_ring, new_ring, sender, sender_cache, receivers = _handoff_rig(
+        peers_old, peers_new, "c2", ["c0", "c1"])
+    try:
+        nonces = _sample_nonces(600)
+        for i, n in enumerate(nonces):
+            if old_ring.owner(n) == "c2":
+                sender_cache.add(n, 2, b"from-c2-%d" % i, trace=None)
+        owned = {n for n, _z, _s in sender_cache.entries_snapshot()}
+        assert owned
+        # one survivor already DOMINATES one moved key: the handoff
+        # push for it must be a stale drop, not a regression
+        pinned = next(n for n in owned if new_ring.owner(n) == "c0")
+        receivers["c0"][1].add(pinned, 9, b"better", trace=None)
+        before_stale = metrics.get("repl.stale_drops")
+        result = sender.handoff(old_ring, new_ring, deadline_s=20.0)
+        assert result["complete"] is True
+        assert result["keys"] == len(owned)
+        for rid in ("c0", "c1"):
+            expect = {n for n in owned if new_ring.owner(n) == rid}
+            got = {n for n, _z, _s in
+                   receivers[rid][1].entries_snapshot()}
+            assert got == expect, f"misdelivered handoff range for {rid}"
+        assert receivers["c0"][1].peek(pinned).secret == b"better"
+        assert metrics.get("repl.stale_drops") > before_stale
+    finally:
+        sender.close()
+        for server, _c, repl in receivers.values():
+            repl.close()
+            server.shutdown()
+
+
+def test_handoff_deadline_bounds_a_frozen_recipient():
+    """A recipient that never answers costs the sender at most the
+    handoff deadline — the ring change is delayed, never wedged; the
+    result reports the incompleteness anti-entropy will heal."""
+    from distpow_tpu.cluster import Replicator
+    from distpow_tpu.runtime.cache import ResultCache
+
+    # a listening socket that accepts and then says NOTHING
+    import socket
+
+    frozen = socket.socket()
+    frozen.bind(("127.0.0.1", 0))
+    frozen.listen(1)
+    addr = "127.0.0.1:%d" % frozen.getsockname()[1]
+    old_ring = HashRing([("c0", "o0:1"), ("c1", addr)])
+    new_ring = HashRing([("c0", "o0:1"), ("c1", addr)], version=1)
+    # force a remap by building the new ring with an extra member and
+    # sending to the frozen one: simplest is old=solo-owner, new=pair
+    old_ring = HashRing([("c0", "o0:1")])
+    sender_cache = ResultCache()
+    sender = Replicator(sender_cache, replicas=0)
+    sender._state = ClusterState(old_ring, "c0")
+    for n in _sample_nonces(64):
+        sender_cache.add(n, 1, b"x", trace=None)
+    moved = [n for n, _z, _s in sender_cache.entries_snapshot()
+             if new_ring.owner(n) == "c1"]
+    assert moved
+    try:
+        t0 = time.monotonic()
+        result = sender.handoff(old_ring, new_ring, deadline_s=1.0)
+        wall = time.monotonic() - t0
+        assert wall < 8.0, f"frozen recipient held the handoff {wall:.1f}s"
+        assert result["complete"] is False
+        assert result["keys"] < result["expected"]
+    finally:
+        sender.close()
+        frozen.close()
+
+
+def test_membership_change_hands_off_before_installing_new_ring():
+    """Coordinator-level wiring: re-invoking set_cluster_peers with a
+    grown pool runs the warm handoff BEFORE the new ring is installed,
+    bumps the ring version (so clients adopt), and the new member
+    starts WARM for the ranges it inherited."""
+    cluster = _pool()
+    extra = None
+    try:
+        ring0 = cluster.coordinators[0].handler.cluster.ring
+        assert ring0.version == 0
+        # pre-warm member 0 with entries across its range
+        for i, n in enumerate(_sample_nonces(400)):
+            if ring0.owner(n) == "c0":
+                cluster.coordinators[0].handler.result_cache.add(
+                    n, 2, b"warm%d" % i, trace=None)
+        # boot the joining third member and rewire the whole pool
+        from distpow_tpu.nodes import Coordinator
+        from distpow_tpu.runtime.config import CoordinatorConfig
+
+        extra = Coordinator(CoordinatorConfig(
+            ClientAPIListenAddr="127.0.0.1:0",
+            WorkerAPIListenAddr="127.0.0.1:0",
+            Workers=["pending:0"] * len(cluster.worker_addrs),
+        ))
+        extra_client_addr, _w = extra.initialize_rpcs()
+        extra.set_worker_addrs(cluster.worker_addrs)
+        peers = cluster.client_addrs + [extra_client_addr]
+        # the JOINING member adopts the grown ring first, so it can
+        # receive handoff pushes the moment the losers start sending
+        extra.set_cluster_peers(peers, 2)
+        before_keys = metrics.get("repl.handoff_keys")
+        for i, c in enumerate(cluster.coordinators):
+            c.set_cluster_peers(peers, i)
+        new_ring = cluster.coordinators[0].handler.cluster.ring
+        assert new_ring.version == 1
+        moved = [n for n, _z, _s in
+                 cluster.coordinators[0].handler.result_cache
+                 .entries_snapshot()
+                 if ring0.owner(n) == "c0" and new_ring.owner(n) == "c2"]
+        assert moved, "growing the pool must remap some warmed keys"
+        assert metrics.get("repl.handoff_keys") >= before_keys + len(moved)
+        recv = extra.handler.result_cache
+        for n in moved:
+            assert recv.peek(n) is not None, \
+                "new member is cold for a handed-off key"
+    finally:
+        if extra is not None:
+            extra.shutdown()
+        cluster.close()
+
+
+def test_single_coordinator_mode_carries_no_replication_plane():
+    """Byte-identity pin (acceptance criterion): a single-coordinator
+    deployment constructs NO replicator, registers NO Cluster service,
+    and mints NO repl.* traffic — every pre-cluster code path runs
+    exactly as before."""
+    cluster = _pool(n_coordinators=1)
+    try:
+        coord = cluster.coordinators[0]
+        assert coord._replicator is None
+        assert coord.handler.replicator is None
+        assert coord.handler.cluster is None
+        assert "Cluster" not in coord.server._services
+        before = {k: metrics.get(k) for k in
+                  ("repl.pushes", "repl.installs", "repl.push_failures",
+                   "repl.handoff_keys", "repl.antientropy_rounds")}
+        _mine_ok(cluster, b"\x77\x01", 1)
+        for k, v in before.items():
+            assert metrics.get(k) == v, f"{k} moved in single mode"
+        snap = coord.handler.Stats({})
+        assert "replication" not in snap and "cluster" not in snap
+    finally:
+        cluster.close()
+
+
+def test_replication_wire_vocabulary_is_append_only():
+    """The CacheSync/Handoff methods and their params extend the
+    wire-v2 intern tables at the END — existing frames keep their
+    byte encodings (the golden vectors in test_wire.py pin them)."""
+    assert wire.METHODS[-2:] == ("Cluster.CacheSync", "Cluster.Handoff")
+    assert wire.KEYS[-4:] == ("entries", "digest", "installed", "stale")
+    # and a CacheSync frame round-trips on the binary codec
+    entries = [{"nonce": b"\x01\x02", "num_trailing_zeros": 3,
+                "secret": b"\xaa"}]
+    frame = {"id": 1, "method": "Cluster.CacheSync",
+             "params": {"entries": entries, "self": "c0"}}
+    assert wire.decode_frame(wire.encode_frame(frame)) == frame
+
+
+def test_cache_sync_rpc_serves_digests_and_rejects_without_replicator():
+    """The digest half of Cluster.CacheSync over a real pool: a peer
+    asks for this member's view of the requester's replicated range."""
+    cluster = _pool(coord_extra={"ClusterAntiEntropyS": 0.0})
+    try:
+        ring = cluster.client.pow._ring
+        nonce = _nonce_owned_by(ring, "c1", tag=23)
+        _mine_ok(cluster, nonce, 1)
+        sibling = cluster.coordinators[0].handler.result_cache
+        _wait_for(lambda: sibling.peek(nonce) is not None,
+                  what="replica install before digest probe")
+        client = rpc.RPCClient(cluster.client_addrs[0], codec="json")
+        try:
+            reply = client.call("Cluster.CacheSync",
+                                {"digest": 8, "self": "c1"}, timeout=5.0)
+            digests = reply["digest"]
+            assert len(digests) == 8
+            assert sum(d[0] for d in digests) >= 1  # the replica counts
+        finally:
+            client.close()
+    finally:
+        cluster.close()
+
+
 def test_admission_reject_still_typed_for_single_coordinator():
     """Guard: the cluster exception plumbing must not perturb the
     existing RETRY_AFTER typing (both carry extra response fields)."""

@@ -134,6 +134,18 @@ CASES = [
     # and the suppression protocol
     ("modulo-routing", os.path.join("nodes", "modulo_routing_bad.py"),
      os.path.join("nodes", "modulo_routing_ok.py"), 3),
+    # cache replication (ISSUE 16): both rules now cover cluster/ — the
+    # replication plane loops over peer collections with RPCs and
+    # per-target sender spawns inside, exactly the shapes these rules
+    # police; the ok fixtures bless issue-then-await, the persistent
+    # pusher, and the justified-suppression protocol the real
+    # cluster/replication.py loops follow
+    ("serial-rpc-fanout",
+     os.path.join("cluster", "serial_rpc_fanout_bad.py"),
+     os.path.join("cluster", "serial_rpc_fanout_ok.py"), 3),
+    ("unbounded-thread-spawn",
+     os.path.join("cluster", "unbounded_thread_spawn_bad.py"),
+     os.path.join("cluster", "unbounded_thread_spawn_ok.py"), 3),
 ]
 
 

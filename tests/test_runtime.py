@@ -226,6 +226,64 @@ def test_cache_journal_compaction(tmp_path):
     c3.close()
 
 
+def test_cache_compaction_killed_mid_write_replays_fully(tmp_path,
+                                                         monkeypatch):
+    """ISSUE 16 satellite: compaction is crash-consistent.  A kill at
+    EITHER crash point — mid-temp-file-write, or between write and
+    rename — must leave the original journal byte-intact so the next
+    open replays every entry (no shortened replay, no torn mix)."""
+    import distpow_tpu.runtime.cache as cache_mod
+
+    path = str(tmp_path / "cache.jsonl")
+    c1 = ResultCache(persist_path=path)
+    for ntz in range(1, 8):
+        c1.add(b"\x01", ntz, bytes([ntz]), None)
+    c1.add(b"\x02", 2, b"\xbe", None)
+    c1.close()
+    with open(path, "rb") as fh:
+        journal_before = fh.read()
+
+    class Killed(RuntimeError):
+        pass
+
+    # crash point 1: the temp-file write dies partway (disk full, kill)
+    real_fsync = os.fsync
+
+    def dying_fsync(fd):
+        raise Killed("killed mid-compaction-write")
+
+    monkeypatch.setattr(cache_mod.os, "fsync", dying_fsync)
+    with pytest.raises(Killed):
+        ResultCache(persist_path=path)  # 9 lines / 2 entries: compacts
+    monkeypatch.setattr(cache_mod.os, "fsync", real_fsync)
+    with open(path, "rb") as fh:
+        assert fh.read() == journal_before, \
+            "crash mid-temp-write mutated the original journal"
+
+    # crash point 2: the atomic rename itself never happens
+    def dying_replace(src, dst):
+        raise Killed("killed before rename")
+
+    monkeypatch.setattr(cache_mod.os, "replace", dying_replace)
+    with pytest.raises(Killed):
+        ResultCache(persist_path=path)
+    monkeypatch.undo()
+    with open(path, "rb") as fh:
+        assert fh.read() == journal_before, \
+            "crash before rename mutated the original journal"
+
+    # the uncompacted journal still replays to the FULL converged state
+    c2 = ResultCache(persist_path=path)
+    assert len(c2) == 2
+    assert c2.get(b"\x01", 7, None) == bytes([7])
+    assert c2.get(b"\x02", 2, None) == b"\xbe"
+    c2.close()
+    # and an unimpeded restart compacts + keeps everything
+    c3 = ResultCache(persist_path=path)
+    assert len(c3) == 2 and c3.get(b"\x01", 7, None) == bytes([7])
+    c3.close()
+
+
 # --- RPC --------------------------------------------------------------------
 
 class EchoService:
