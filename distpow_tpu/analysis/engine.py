@@ -421,6 +421,7 @@ def run_analysis(
 
     findings: List[Finding] = []
     suppressed: List[Tuple[Finding, Suppression]] = []
+    executed = {r.RULE_ID for r in rules}
     checked = 0
     for root in roots:
         # directory-level rules (dead-package) see the root, not files
@@ -428,6 +429,12 @@ def run_analysis(
             scan_tree = getattr(rule, "scan_tree", None)
             if scan_tree is not None and os.path.isdir(root):
                 findings.extend(scan_tree(root, rel_to or ".", context))
+        # load EVERY module under the root first: project-level rules
+        # (``check_project(modules, context)`` — the interprocedural
+        # concurrency passes, docs/CONCURRENCY.md) need the whole tree
+        # to resolve helper calls across files, and their findings must
+        # still land in the owning module's suppression pass
+        modules: List[Module] = []
         for path in iter_py_files(root):
             rel = os.path.relpath(path, rel_to) if rel_to else path
             module = load_module(path, rel)
@@ -438,13 +445,25 @@ def run_analysis(
                 ))
                 continue
             checked += 1
-            mod_findings: List[Finding] = []
+            modules.append(module)
+        per_module: Dict[str, List[Finding]] = {m.path: [] for m in modules}
+        for module in modules:
             for rule in rules:
                 check = getattr(rule, "check", None)
                 if check is not None:
-                    mod_findings.extend(check(module, context))
+                    per_module[module.path].extend(check(module, context))
+        for rule in rules:
+            check_project = getattr(rule, "check_project", None)
+            if check_project is None:
+                continue
+            for f in check_project(modules, context):
+                if f.path in per_module:
+                    per_module[f.path].append(f)
+                else:  # finding on a path outside the scan: keep it raw
+                    findings.append(f)
+        for module in modules:
             act, sup = _apply_suppressions(
-                module, mod_findings, {r.RULE_ID for r in rules}
+                module, per_module[module.path], executed
             )
             findings.extend(act)
             suppressed.extend(sup)

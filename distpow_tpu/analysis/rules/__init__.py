@@ -8,19 +8,25 @@ from . import (
     bounded_queue,
     config_key_sync,
     dead_package,
+    guarded_by,
     hot_path_host_sync,
+    lock_order,
     metrics_registry,
     modulo_routing,
     relaunch_loop_sync,
     serial_rpc_fanout,
     silent_except,
     trace_vocabulary,
+    transitive_blocking,
     unbounded_thread_spawn,
     unclosed_span,
 )
 
 ALL_RULES = (
     blocking_under_lock,
+    transitive_blocking,
+    guarded_by,
+    lock_order,
     bounded_queue,
     serial_rpc_fanout,
     unbounded_thread_spawn,

@@ -54,6 +54,11 @@ from typing import Dict, List, Optional, Tuple
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.rpc import RPCClient, RPCError
 from ..runtime.telemetry import RECORDER
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # typing only: the replicator treats the cache as
+    from ..runtime.cache import ResultCache  # an add/snapshot surface
 from .ring import HashRing
 from .service import ClusterState
 
@@ -111,13 +116,13 @@ class Replicator:
     coordinators never see it.
     """
 
-    def __init__(self, cache, *, replicas: int = 1,
+    def __init__(self, cache: "ResultCache", *, replicas: int = 1,
                  queue_depth: int = 1024,
                  antientropy_s: float = 5.0,
                  handoff_deadline_s: float = 5.0,
                  push_timeout_s: float = 5.0,
                  digest_buckets: int = 32,
-                 antientropy_max_entries: int = 512):
+                 antientropy_max_entries: int = 512) -> None:
         self._cache = cache
         self.replicas = max(0, int(replicas))
         self.antientropy_s = float(antientropy_s)
@@ -208,7 +213,7 @@ class Replicator:
                 metrics.inc("repl.push_failures", len(batch))
                 log.exception("replication push batch failed")
 
-    def _push_batch(self, batch) -> None:
+    def _push_batch(self, batch: list) -> None:
         with self._lock:
             state = self._state
         if state is None:
@@ -237,7 +242,7 @@ class Replicator:
                 self._drop_client(target)
 
     # -- replica install (both Cluster RPCs funnel here) ---------------------
-    def install(self, entries) -> Tuple[int, int]:
+    def install(self, entries: Optional[list]) -> Tuple[int, int]:
         """Install pushed entries through the dominance order; returns
         ``(installed, stale)``.  A stale push can never regress the
         replica — ``add`` rejects it and we count the proof."""
@@ -426,7 +431,7 @@ class Replicator:
         return {"keys": pushed, "expected": expected,
                 "targets": len(moved), "complete": complete}
 
-    def _handoff_to(self, target: str, addr: Optional[str], entries,
+    def _handoff_to(self, target: str, addr: Optional[str], entries: list,
                     deadline: float, results: dict) -> None:
         with self._lock:
             state = self._state

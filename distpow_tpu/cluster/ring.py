@@ -61,7 +61,7 @@ class HashRing:
                  "_addrs")
 
     def __init__(self, members: Sequence[Tuple[str, str]],
-                 vnodes: int = DEFAULT_VNODES, version: int = 0):
+                 vnodes: int = DEFAULT_VNODES, version: int = 0) -> None:
         if not members:
             raise ValueError("a hash ring needs at least one member")
         ids = [m for m, _ in members]
@@ -138,7 +138,7 @@ class HashRing:
             version=int(data.get("version") or 0),
         )
 
-    def __eq__(self, other) -> bool:
+    def __eq__(self, other: object) -> bool:
         return (isinstance(other, HashRing)
                 and self.members == other.members
                 and self.vnodes == other.vnodes)

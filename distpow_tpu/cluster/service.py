@@ -29,8 +29,13 @@ surface changes.
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING, Optional
+
 from ..runtime.metrics import REGISTRY as metrics
 from .ring import HashRing
+
+if TYPE_CHECKING:  # import cycle: replication imports nothing from
+    from .replication import CacheReplicator  # here, but keep it lazy
 
 
 class NotOwnerError(Exception):
@@ -43,7 +48,7 @@ class NotOwnerError(Exception):
     ``RPCNotOwner`` — machine-readable redirect, not a string to parse.
     """
 
-    def __init__(self, owner: str, ring_wire: dict):
+    def __init__(self, owner: str, ring_wire: dict) -> None:
         super().__init__(
             f"NOT_OWNER: key is owned by shard {owner!r} "
             f"(ring v{ring_wire.get('version', 0)})"
@@ -57,7 +62,7 @@ class ClusterState:
 
     __slots__ = ("ring", "self_id")
 
-    def __init__(self, ring: HashRing, self_id: str):
+    def __init__(self, ring: HashRing, self_id: str) -> None:
         if ring.addr_of(self_id) is None:
             raise ValueError(
                 f"self id {self_id!r} is not a ring member "
@@ -80,16 +85,17 @@ class ClusterService:
     ``Cluster.CacheSync``/``Cluster.Handoff`` when a replicator is
     wired, i.e. only in pool mode)."""
 
-    def __init__(self, state: ClusterState, replicator=None):
+    def __init__(self, state: ClusterState,
+                 replicator: Optional["CacheReplicator"] = None) -> None:
         self._state = state
         self._replicator = replicator
 
-    def Ring(self, params) -> dict:
+    def Ring(self, params: dict) -> dict:
         metrics.inc("cluster.ring_serves")
         return {"ring": self._state.ring.to_wire(),
                 "self": self._state.self_id}
 
-    def CacheSync(self, params) -> dict:
+    def CacheSync(self, params: dict) -> dict:
         """Replication peer traffic (cluster/replication.py).
 
         Two shapes share the method so the wire vocabulary stays small:
@@ -110,7 +116,7 @@ class ClusterService:
         installed, stale = repl.install(params.get("entries"))
         return {"installed": installed, "stale": stale}
 
-    def Handoff(self, params) -> dict:
+    def Handoff(self, params: dict) -> dict:
         """Warm shard handoff receiver: a member losing keys on a ring
         change pushes the remapped entries here BEFORE acking the new
         ring.  Same dominance-ordered install as CacheSync — arriving

@@ -51,7 +51,7 @@ class FleetAgent:
 
     def __init__(self, worker_id: str, coord_addr: str, listen_addr: str,
                  capability: Capability, heartbeat_s: float = 0.0,
-                 drain_timeout_s: float = 20.0):
+                 drain_timeout_s: float = 20.0) -> None:
         self.worker_id = worker_id
         self.coord_addr = coord_addr
         self.listen_addr = listen_addr

@@ -55,7 +55,7 @@ class Capability:
         )
 
 
-def calibrate_mhs(backend, budget_s: float = 0.2,
+def calibrate_mhs(backend: object, budget_s: float = 0.2,
                   nonce: bytes = b"\xfc\x01", difficulty: int = 8) -> float:
     """Measure the backend's hash rate with a short budgeted search.
 

@@ -40,7 +40,7 @@ import secrets
 import statistics
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional, Tuple
 
 from ..parallel import partition
 from ..runtime.metrics import REGISTRY as metrics
@@ -48,24 +48,30 @@ from ..runtime.spans import SPANS
 from ..runtime.telemetry import RECORDER
 from .capability import Capability
 
+if TYPE_CHECKING:  # runtime import would be circular (nodes -> fleet)
+    from ..nodes.coordinator import WorkerRef
+
 
 class WorkerLease:
-    """One member's lease state (guarded by the registry lock)."""
+    """One member's lease state (guarded by the registry lock — the
+    mutable fields below carry ``# guarded-by`` declarations, so
+    distpow-lint enforces what this docstring used to merely say:
+    docs/CONCURRENCY.md)."""
 
     __slots__ = ("lease_id", "worker_id", "ttl_s", "permanent", "state",
                  "last_beat", "registered_at", "beat_ema_s", "capability")
 
     def __init__(self, worker_id: str, ttl_s: float, permanent: bool,
-                 capability: Optional[Capability] = None):
+                 capability: Optional[Capability] = None) -> None:
         self.lease_id = secrets.token_hex(8)
         self.worker_id = worker_id
         self.ttl_s = float(ttl_s)
         self.permanent = bool(permanent)
-        self.state = "live"  # live | draining
-        self.last_beat = time.monotonic()
+        self.state = "live"  # live | draining; guarded-by: registry._lock
+        self.last_beat = time.monotonic()  # guarded-by: registry._lock
         self.registered_at = self.last_beat
         #: observed heartbeat cadence (EMA); None until two beats landed
-        self.beat_ema_s: Optional[float] = None
+        self.beat_ema_s: Optional[float] = None  # guarded-by: registry._lock
         self.capability = capability
 
     def beat(self) -> None:
@@ -113,7 +119,7 @@ class RoundPlan:
     __slots__ = ("entries", "worker_bits", "ranges")
 
     def __init__(self, entries: List[tuple], worker_bits: int,
-                 ranges: Optional[Dict[int, Tuple[int, int]]]):
+                 ranges: Optional[Dict[int, Tuple[int, int]]]) -> None:
         #: ``[(WorkerRef, shard_id), ...]`` — shard_id doubles as the
         #: wire ``worker_byte`` (the partition travels in the RPC, so a
         #: foreign shard on a reassigned/hedged worker is routine)
@@ -145,15 +151,16 @@ class FleetRegistry:
     def __init__(self, refs: List[object], lease_ttl_s: float = 10.0,
                  hedge: bool = True, hedge_multiple: float = 3.0,
                  on_expire: Optional[Callable[[object], None]] = None,
-                 make_ref: Optional[Callable[[str, int], object]] = None):
+                 make_ref: Optional[Callable[[str, int], object]] = None) -> None:
         self._lock = threading.Lock()
-        self.refs = refs  # shared with CoordRPCHandler.workers
+        #: shared with CoordRPCHandler.workers
+        self.refs: List["WorkerRef"] = refs
         self.lease_ttl_s = float(lease_ttl_s)
         self.hedge_enabled = bool(hedge)
         self.hedge_multiple = float(hedge_multiple)
         self._on_expire = on_expire
         self._make_ref = make_ref
-        self._by_lease: Dict[str, object] = {}  # lease_id -> WorkerRef
+        self._by_lease: Dict[str, "WorkerRef"] = {}
         self._next_byte = len(refs)
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -177,11 +184,11 @@ class FleetRegistry:
                    if r.lease is not None and r.lease.state == "live")
         metrics.gauge("fleet.live_workers", live)
 
-    def _in_service(self, ref) -> bool:
+    def _in_service(self, ref: "WorkerRef") -> bool:
         lease = getattr(ref, "lease", None)
         return lease is not None and lease.state == "live"
 
-    def in_service(self, ref) -> bool:
+    def in_service(self, ref: "WorkerRef") -> bool:
         with self._lock:
             return self._in_service(ref)
 
@@ -301,21 +308,25 @@ class FleetRegistry:
         worker joins the same orphan-reassignment path a crashed one
         does.  Returns the retired refs (tests and the bench poll it)."""
         now = time.monotonic() if now is None else now
-        expired: List[object] = []
+        # beat ages snapshot INSIDE the lock with the expiry decision:
+        # last_beat is written by heartbeat() on RPC handler threads,
+        # and the old bare reads below the critical section raced it
+        # (unguarded-shared-write sweep, ISSUE 17)
+        expired: List[Tuple[object, float]] = []
         with self._lock:
             for ref in list(self.refs):
                 lease = getattr(ref, "lease", None)
                 if lease is not None and lease.expired(now):
-                    expired.append(ref)
+                    expired.append((ref, round(now - lease.last_beat, 3)))
                     self.refs.remove(ref)
                     self._by_lease.pop(lease.lease_id, None)
             if expired:
                 self._publish_gauge_locked()
-        for ref in expired:
+        for ref, beat_age_s in expired:
             metrics.inc("fleet.lease_expiries")
             RECORDER.record("fleet.lease_expiry",
                             worker_id=ref.lease.worker_id,
-                            beat_age_s=round(now - ref.lease.last_beat, 3),
+                            beat_age_s=beat_age_s,
                             ttl_s=ref.lease.ttl_s)
             # fleet-scoped forensics marker (docs/FORENSICS.md): no
             # request in scope on the reaper thread, so this records
@@ -325,10 +336,10 @@ class FleetRegistry:
             SPANS.event("fleet.lease_expiry", trace_id=0,
                         worker_id=ref.lease.worker_id,
                         worker_byte=getattr(ref, "worker_byte", None),
-                        beat_age_s=round(now - ref.lease.last_beat, 3))
+                        beat_age_s=beat_age_s)
             if self._on_expire is not None:
                 self._on_expire(ref)
-        return expired
+        return [ref for ref, _ in expired]
 
     # -- round planning -----------------------------------------------------
     def round_plan(self) -> RoundPlan:
@@ -384,15 +395,25 @@ class FleetRegistry:
     def hedge_after_s(self) -> float:
         return self.hedge_multiple * self.median_beat_interval()
 
-    def is_stale(self, ref, threshold_s: Optional[float] = None) -> bool:
+    def is_stale(self, ref: "WorkerRef",
+                 threshold_s: Optional[float] = None) -> bool:
         """True when a HEARTBEAT member has not reported for longer
         than ``threshold_s`` (default: the hedge threshold).  Permanent
         leases never heartbeat, so they are never stale — static fleets
-        keep their probe-based failure detection unchanged."""
-        lease = getattr(ref, "lease", None)
-        if lease is None or lease.permanent:
-            return False
-        age = lease.beat_age(time.monotonic())
+        keep their probe-based failure detection unchanged.
+
+        The beat clock is read under the registry lock: ``last_beat``
+        is written by ``heartbeat()`` on RPC handler threads, and the
+        bare read here raced it (found by distpow-lint's
+        unguarded-shared-write sweep, ISSUE 17 — ``test_is_stale_
+        reads_beat_clock_under_registry_lock`` pins the discipline).
+        ``hedge_after_s()`` re-takes the lock, so it must stay outside
+        the critical section."""
+        with self._lock:
+            lease = getattr(ref, "lease", None)
+            if lease is None or lease.permanent:
+                return False
+            age = lease.beat_age(time.monotonic())
         t = self.hedge_after_s() if threshold_s is None else threshold_s
         return age is not None and age > t
 
@@ -421,11 +442,11 @@ class FleetService:
     params and the registry."""
 
     def __init__(self, registry: FleetRegistry,
-                 drain_timeout_s: float = 20.0):
+                 drain_timeout_s: float = 20.0) -> None:
         self._registry = registry
         self._drain_timeout_s = float(drain_timeout_s)
 
-    def Register(self, params) -> dict:
+    def Register(self, params: dict) -> dict:
         cap = Capability.from_wire(params.get("capability"))
         return self._registry.register(
             str(params.get("worker_id") or ""),
@@ -433,10 +454,10 @@ class FleetService:
             cap,
         )
 
-    def Heartbeat(self, params) -> dict:
+    def Heartbeat(self, params: dict) -> dict:
         return self._registry.heartbeat(str(params.get("lease_id") or ""))
 
-    def Drain(self, params) -> dict:
+    def Drain(self, params: dict) -> dict:
         # the wait bound is CLAMPED by the coordinator's own configured
         # ceiling: the TTL exemption for draining leases (expired())
         # holds only because this wait provably releases — a
@@ -451,7 +472,7 @@ class FleetService:
             str(params.get("lease_id") or ""), timeout_s=timeout,
         )
 
-    def Members(self, params) -> dict:
+    def Members(self, params: dict) -> dict:
         return {"workers": self._registry.members(),
                 "lease_ttl_s": self._registry.lease_ttl_s,
                 "hedge": self._registry.hedge_enabled}

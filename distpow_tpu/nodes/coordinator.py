@@ -79,7 +79,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ..cluster import ClusterService, ClusterState, NotOwnerError, \
     Replicator, ring_from_peers
-from ..fleet.membership import FleetRegistry, FleetService, RoundPlan
+from ..fleet.membership import (FleetRegistry, FleetService, RoundPlan,
+                                WorkerLease)
 from ..parallel.partition import worker_bits as partition_worker_bits
 from ..runtime import actions as act
 from ..runtime.cache import ResultCache
@@ -233,8 +234,8 @@ class WorkerRef:
         # membership state (distpow_tpu/fleet/): static config workers
         # get a permanent lease at registry construction; elastic
         # workers a heartbeat lease at Fleet.Register
-        self.lease = None
-        self.inflight_rounds = 0
+        self.lease: Optional[WorkerLease] = None
+        self.inflight_rounds: int = 0
 
 
 class CoordRPCHandler:
@@ -595,6 +596,12 @@ class CoordRPCHandler:
                 # fix; with coalescing on, only round leaders ever
                 # contend here)
                 with self._key_lock(key):
+                    # distpow: ok transitive-blocking-under-lock -- the
+                    # per-key lock exists precisely to serialize the
+                    # whole miss path for one (nonce, ntz): concurrent
+                    # identical requests MUST wait for the leader's
+                    # result; other keys use other locks, so fanout
+                    # stays concurrent across keys
                     cached = None if model else self.result_cache.get(
                         nonce, ntz, trace)
                     if cached is not None:
@@ -602,9 +609,19 @@ class CoordRPCHandler:
                         metrics.observe("coord.mine_s.hit",
                                         time.monotonic() - t0,
                                         trace_id=tid)
+                        # distpow: ok transitive-blocking-under-lock -- same
+                        # per-key serialization invariant as the cache
+                        # probe above; the reply's span bookkeeping is
+                        # bounded work on the key's own critical path
                         return self._success_reply(trace, nonce, ntz, cached)
                     reserved = self._admit(nonce, ntz)
                     try:
+                        # distpow: ok transitive-blocking-under-lock -- the
+                        # miss itself runs under the per-key lock BY
+                        # DESIGN (docs/COALESCING.md): followers for the
+                        # same key block here until the leader finishes,
+                        # then hit the cache; reconnect-dials inside are
+                        # bounded by the RPC attempt timeout
                         return self._mine_miss(trace, nonce, ntz, model)
                     finally:
                         if reserved:

@@ -305,6 +305,10 @@ class POW:
             self.backoff_max_s = float(backoff_max_s)
         if attempt_timeout_s:  # 0/None both mean "wait forever"
             self.attempt_timeout_s = float(attempt_timeout_s)
+        # distpow: ok unguarded-shared-write -- write-once before any
+        # reader thread exists: initialize() runs before the notify
+        # pump starts, so no thread can observe the handoff; later
+        # swaps (in _reconnect) do take _conn_lock
         self.coordinator = RPCClient(coord_addr)
         self.notify_queue = queue.Queue(maxsize=ch_capacity)
         self._close_ev.clear()

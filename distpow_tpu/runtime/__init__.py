@@ -9,6 +9,7 @@ from .config import (
     write_json_config,
 )
 from . import faults
+from . import lockcheck
 from .rpc import RPCClient, RPCError, RPCServer, RPCTransportError
 from .trace_server import TracingServer
 from .tracing import (
@@ -24,7 +25,7 @@ from .tracing import (
 )
 
 __all__ = [
-    "actions", "faults", "CacheEntry", "ResultCache",
+    "actions", "faults", "lockcheck", "CacheEntry", "ResultCache",
     "ClientConfig", "CoordinatorConfig", "TracingServerConfig", "WorkerConfig",
     "read_json_config", "write_json_config",
     "RPCClient", "RPCError", "RPCServer", "RPCTransportError", "TracingServer",

@@ -116,6 +116,11 @@ class TCPSink:
             for attempt in (0, 1):
                 try:
                     if self._sock is None:
+                        # distpow: ok transitive-blocking-under-lock -- the
+                        # sink lock doubles as the exclusive-redialer
+                        # guard: exactly one tracer thread dials after a
+                        # drop while the rest queue behind it, and the
+                        # dial is bounded by the connect timeout
                         self._sock = self._connect()
                     # distpow: ok no-blocking-under-lock -- the sink lock
                     # is the per-connection frame serializer (same

@@ -34,7 +34,7 @@ class AdmissionReject(RuntimeError):
     shows a human-actionable error.
     """
 
-    def __init__(self, retry_after_s: float, detail: str = ""):
+    def __init__(self, retry_after_s: float, detail: str = "") -> None:
         self.retry_after_s = float(retry_after_s)
         msg = f"retry-after:{self.retry_after_s:.3f}s"
         if detail:

@@ -49,8 +49,8 @@ class Handle:
 
     __slots__ = ("_coalescer", "_key", "_round", "leader")
 
-    def __init__(self, coalescer: "Coalescer", key, round_: _Round,
-                 leader: bool):
+    def __init__(self, coalescer: "Coalescer", key: tuple, round_: _Round,
+                 leader: bool) -> None:
         self._coalescer = coalescer
         self._key = key
         self._round = round_
@@ -74,7 +74,7 @@ class Coalescer:
         self._lock = threading.Lock()
         self._rounds: Dict[Tuple, _Round] = {}
 
-    def join(self, key) -> Handle:
+    def join(self, key: tuple) -> Handle:
         with self._lock:
             r = self._rounds.get(key)
             if r is None:
@@ -83,7 +83,8 @@ class Coalescer:
             r.waiters += 1
             return Handle(self, key, r, leader=False)
 
-    def _finish(self, key, round_: _Round, error) -> None:
+    def _finish(self, key: tuple, round_: _Round,
+                error: Optional[BaseException]) -> None:
         with self._lock:
             if self._rounds.get(key) is round_:
                 del self._rounds[key]

@@ -87,8 +87,9 @@ class Slot:
     )
 
     def __init__(self, seq: int, nonce: bytes, ntz: int, tb_lo: int,
-                 tbc: int, cancel_check, weight: float, masks, segments,
-                 model):
+                 tbc: int, cancel_check: Optional[Callable[[], bool]],
+                 weight: float, masks: object, segments: object,
+                 model: object) -> None:
         self.model = model
         self.seq = seq
         self.nonce = nonce
@@ -119,6 +120,10 @@ class Slot:
 
     def cancel(self) -> None:
         """Request cancellation; honored at the next launch boundary."""
+        # distpow: ok unguarded-shared-write -- monotonic False->True
+        # flag set from caller threads; the device loop re-reads it at
+        # every launch boundary (cancel_requested), so the worst a
+        # bare store costs is one extra launch, never a missed cancel
         self._cancelled = True
 
     def cancel_requested(self) -> bool:
@@ -147,8 +152,10 @@ class BatchingScheduler:
     """
 
     def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20,
-                 max_slots: int = 8, max_width: int = 8, fallback=None,
-                 start: bool = True, extra_models: Sequence[str] = ()):
+                 max_slots: int = 8, max_width: int = 8,
+                 fallback: object = None,
+                 start: bool = True,
+                 extra_models: Sequence[str] = ()) -> None:
         self.model = get_hash_model(hash_model)
         # models the packed step serves: the default plus any configured
         # extras (WorkerConfig.SchedHashModels).  Slots of different
@@ -270,8 +277,9 @@ class BatchingScheduler:
             self._cond.notify_all()
         return slot
 
-    def _solo(self, nonce, difficulty, thread_bytes, cancel_check,
-              hash_model: Optional[str]):
+    def _solo(self, nonce: bytes, difficulty: int, thread_bytes: bytes,
+              cancel_check: Optional[Callable[[], bool]],
+              hash_model: Optional[str]) -> Optional[bytes]:
         """Route one search outside the packed step.
 
         Default-model shapes go to the wrapped fallback backend (it was
@@ -315,8 +323,9 @@ class BatchingScheduler:
         )
         return None if res is None else res.secret
 
-    def search(self, nonce, difficulty, thread_bytes, cancel_check=None,
-               hash_model: Optional[str] = None):
+    def search(self, nonce: bytes, difficulty: int, thread_bytes: bytes,
+               cancel_check: Optional[Callable[[], bool]] = None,
+               hash_model: Optional[str] = None) -> Optional[bytes]:
         """Backend-compatible facade: first solving secret or None."""
         if self._dead or not self.supports(difficulty, thread_bytes,
                                            hash_model):
@@ -354,7 +363,7 @@ class BatchingScheduler:
         return False
 
     @staticmethod
-    def _group_key(slot: Slot):
+    def _group_key(slot: Slot) -> tuple:
         # slots sharing (model, tail layout) can share one vmapped lane
         # stack; DIFFERENT groups still share the LAUNCH through the
         # mixed step, whose compile key is the ordered group-key set
@@ -449,7 +458,7 @@ class BatchingScheduler:
         return cohort
 
     @staticmethod
-    def _lane_ops(lanes: List[Slot]):
+    def _lane_ops(lanes: List[Slot]) -> tuple:
         import jax.numpy as jnp
 
         return (

@@ -61,7 +61,13 @@
 # Node.Spans sweep must stitch a timeline naming the delayed worker's
 # shard; trace_check must still report 0 violations — ~15 s, CPU,
 # no jax.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]
+# `--race-audit` runs the concurrency suites (fleet, cluster, sched,
+# chaos matrix, lockcheck's own tests) under the RUNTIME lock-order
+# audit (DISTPOW_LOCK_CHECK=1, runtime/lockcheck.py): every repo lock
+# acquisition is recorded into an order graph and the session FAILS on
+# any observed inversion — the dynamic twin of the static
+# lock-order-inversion rule (docs/CONCURRENCY.md) — ~2 min, CPU.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--race-audit|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -74,9 +80,10 @@ run_lint() {
   else
     echo "ruff not installed; skipping (pip install -e .[lint])"
   fi
-  echo "=== mypy (strict-leaning on runtime/ + nodes/) ==="
+  echo "=== mypy (strict-leaning on runtime/ nodes/ cluster/ fleet/ sched/) ==="
   if command -v mypy >/dev/null 2>&1; then
-    mypy distpow_tpu/runtime distpow_tpu/nodes
+    mypy distpow_tpu/runtime distpow_tpu/nodes distpow_tpu/cluster \
+         distpow_tpu/fleet distpow_tpu/sched
   else
     echo "mypy not installed; skipping (pip install -e .[lint])"
   fi
@@ -86,6 +93,16 @@ run_lint() {
 # the static gate needs no native build — run and exit early
 if [ "${1:-}" = "--lint" ]; then
   run_lint
+  exit 0
+fi
+
+if [ "${1:-}" = "--race-audit" ]; then
+  echo "=== race audit (runtime lock-order instrumentation, docs/CONCURRENCY.md) ==="
+  DISTPOW_LOCK_CHECK=1 python -m pytest -q \
+    tests/test_lockcheck.py tests/test_fleet.py tests/test_cluster.py \
+    tests/test_sched.py tests/test_faults.py \
+    -m "not slow and not veryslow"
+  echo "=== race audit OK (zero observed lock-order inversions) ==="
   exit 0
 fi
 
@@ -183,7 +200,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--race-audit|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]" >&2
           exit 2 ;;
 esac
 

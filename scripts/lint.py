@@ -4,10 +4,14 @@
 Usage:
     python scripts/lint.py [PATHS...] [--json] [--list-rules]
                            [--rule ID [--rule ID ...]]
-                           [--baseline FILE]
+                           [--baseline FILE] [--rewrite-baseline]
 
 Defaults to scanning ``distpow_tpu/``.  Exit codes: 0 clean (suppressed
-findings allowed), 1 active findings, 2 usage/internal error.  The rule
+findings allowed), 1 active findings, 2 usage/internal error.  Baseline
+hygiene: an entry that no longer matches any current finding is itself
+a ``stale-baseline`` finding (exit 1) — grandfathered debt must shrink
+monotonically, never rot.  ``--rewrite-baseline`` prunes the stale
+entries in place instead of failing.  The rule
 catalog with rationale, examples and the suppression policy lives in
 docs/LINT.md; ``scripts/ci.sh --lint`` runs this plus ruff and mypy
 (both skipped with a note when not installed — the container policy is
@@ -25,8 +29,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from distpow_tpu.analysis import build_context, run_analysis  # noqa: E402
-from distpow_tpu.analysis.engine import load_baseline  # noqa: E402
+from distpow_tpu.analysis.engine import Finding, load_baseline  # noqa: E402
 from distpow_tpu.analysis.rules import ALL_RULES  # noqa: E402
+
+STALE_BASELINE = "stale-baseline"
 
 
 def main(argv=None) -> int:
@@ -45,7 +51,15 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", metavar="FILE",
                     help="JSON baseline of grandfathered findings "
                          "(the committed one is empty and stays empty)")
+    ap.add_argument("--rewrite-baseline", action="store_true",
+                    help="prune baseline entries that no longer match "
+                         "any finding (requires --baseline)")
     args = ap.parse_args(argv)
+
+    if args.rewrite_baseline and not args.baseline:
+        print("lint: --rewrite-baseline requires --baseline",
+              file=sys.stderr)
+        return 2
 
     if args.list_rules:
         for rule in ALL_RULES:
@@ -76,8 +90,31 @@ def main(argv=None) -> int:
             print(f"lint: unreadable baseline {args.baseline}: {exc}",
                   file=sys.stderr)
             return 2
+        current = {(f.rule, f.path, f.message) for f in findings}
         findings = [f for f in findings
                     if (f.rule, f.path, f.message) not in grandfathered]
+        stale = sorted(grandfathered - current)
+        if stale and args.rewrite_baseline:
+            with open(args.baseline) as fh:
+                data = json.load(fh)
+            keep = [f for f in data.get("findings", ())
+                    if (f["rule"], f["path"], f["message"]) in current]
+            data["findings"] = keep
+            with open(args.baseline, "w") as fh:
+                json.dump(data, fh, indent=2)
+                fh.write("\n")
+            print(f"lint: pruned {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} from "
+                  f"{args.baseline}", file=sys.stderr)
+        elif stale:
+            findings = findings + [
+                Finding(STALE_BASELINE, args.baseline, 0,
+                        f"baseline entry [{rule}] {path}: {msg!r} no "
+                        f"longer matches any finding — delete it or run "
+                        f"--rewrite-baseline (grandfathered debt must "
+                        f"shrink, never rot)")
+                for rule, path, msg in stale
+            ]
 
     if args.as_json:
         payload = report.to_json()

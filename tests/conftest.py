@@ -10,7 +10,37 @@ rather than via environment variables.
 Set DISTPOW_TEST_TPU=1 to run the suite on the real accelerator instead.
 """
 
+import importlib.util
 import os
+import sys
+
+import pytest
+
+# -- runtime lock-order audit (docs/CONCURRENCY.md, ISSUE 17) ----------------
+# Load lockcheck standalone (stdlib-only) and pre-seed sys.modules under its
+# canonical name BEFORE anything imports distpow_tpu: the threading-factory
+# patch must be live when module-level singletons (metrics registry, tracer
+# sinks) construct their locks, or those locks escape instrumentation.
+_LC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "distpow_tpu", "runtime", "lockcheck.py")
+_spec = importlib.util.spec_from_file_location(
+    "distpow_tpu.runtime.lockcheck", _LC)
+lockcheck = importlib.util.module_from_spec(_spec)
+sys.modules["distpow_tpu.runtime.lockcheck"] = lockcheck
+_spec.loader.exec_module(lockcheck)
+if lockcheck.enabled():
+    lockcheck.install()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _race_audit():
+    """With DISTPOW_LOCK_CHECK=1, fail the session when the suite
+    observed a lock-order inversion at runtime (ci.sh --race-audit)."""
+    yield
+    if lockcheck.enabled():
+        report = lockcheck.check()
+        assert not report.cycles, lockcheck.format_report(report)
+
 
 os.environ.setdefault("XLA_FLAGS", "")
 if os.environ.get("DISTPOW_TEST_TPU") != "1":

@@ -1,0 +1,48 @@
+"""Fixture: unguarded-shared-write fires on both tiers (ISSUE 17).
+
+Expected findings (3):
+  * ``Annotated.state`` — declared ``# guarded-by: self._mu``, written
+    bare in ``bad_write``;
+  * ``Annotated.count`` — declared guard, READ bare in ``bad_read``
+    (the annotation tier flags reads too);
+  * ``Heuristic.total`` — written under ``_lock`` in ``locked_add``
+    and bare in ``bare_add`` (the discovered tier).
+"""
+
+import threading
+
+
+class Annotated:
+    """Declared discipline: annotated attrs demand the lock on every
+    access, reads included."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.state = "idle"  # guarded-by: self._mu
+        self.count = 0  # guarded-by: self._mu
+
+    def advance(self):
+        with self._mu:
+            self.state = "busy"
+            self.count += 1
+
+    def bad_write(self):
+        self.state = "done"  # BAD: annotated attr, no lock held
+
+    def bad_read(self):
+        return self.count  # BAD: annotated read, no lock held
+
+
+class Heuristic:
+    """Discovered discipline: mixed locked/bare writes, no annotation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def locked_add(self, n):
+        with self._lock:
+            self.total += n
+
+    def bare_add(self, n):
+        self.total += n  # BAD: the same attr is lock-disciplined above

@@ -1,0 +1,46 @@
+"""Fixture: unguarded-shared-write clean shapes (ISSUE 17).
+
+Blessed: every access of an annotated attr under the declared lock —
+including THROUGH a helper whose only call sites hold it (entry-lock
+credit) — plus the justified-suppression protocol for a deliberate
+lock-free invariant, and ``__init__`` writes (pre-publication).
+"""
+
+import threading
+
+
+class Annotated:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.state = "idle"  # guarded-by: self._mu
+
+    def set_state(self, s):
+        with self._mu:
+            self.state = s
+
+    def read_state(self):
+        with self._mu:
+            return self.state
+
+    def _advance_locked(self):
+        # every visible call site holds _mu -> this write inherits it
+        self.state = "advanced"
+
+    def advance(self):
+        with self._mu:
+            self._advance_locked()
+
+
+class DeliberateHotPath:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beat = 0.0
+
+    def locked_set(self, t):
+        with self._lock:
+            self.beat = t
+
+    def hot_set(self, t):
+        # distpow: ok unguarded-shared-write -- GIL-atomic float store
+        # on the hot path; the staleness window tolerates a lost beat
+        self.beat = t

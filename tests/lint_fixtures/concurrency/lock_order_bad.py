@@ -1,0 +1,29 @@
+"""Fixture: lock-order-inversion fires on an INDIRECT cycle (ISSUE 17).
+
+``forward`` acquires ``_a`` and then calls ``_grab_b`` — the edge
+a → b exists only through the call summary, not lexically.
+``backward`` nests ``_a`` under ``_b`` lexically.  Together: one
+cycle, one finding (per strongly-connected component, not per edge).
+"""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def forward(self):
+        with self._a:
+            self._grab_b()  # a -> b via the bounded call summary
+
+    def _grab_b(self):
+        with self._b:
+            self.n += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:  # b -> a lexically: the inversion
+                self.n -= 1

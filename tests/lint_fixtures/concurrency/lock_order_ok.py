@@ -1,0 +1,32 @@
+"""Fixture: lock-order-inversion clean shapes (ISSUE 17).
+
+Blessed: a single global order (always a before b) — lexically, via a
+helper call, and each lock alone; re-entrant single-lock use is not a
+cycle.
+"""
+
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def direct(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def via_helper(self):
+        with self._a:
+            self._grab_b()  # same a -> b direction as `direct`
+
+    def _grab_b(self):
+        with self._b:
+            self.n += 1
+
+    def b_alone(self):
+        with self._b:
+            self.n -= 1

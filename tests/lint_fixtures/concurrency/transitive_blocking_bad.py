@@ -1,0 +1,41 @@
+"""Fixture: transitive-blocking-under-lock fires (ISSUE 17).
+
+Expected findings (2):
+  * ``Cache.lookup`` calls ``_fetch`` under ``_lock``; the blocking
+    ``time.sleep`` sits TWO call hops away (``_fetch`` → ``_pull``) —
+    invisible to the lexical rule, caught by the bounded summaries;
+  * ``CondHolder.drain`` sleeps directly under a ``Condition`` named
+    ``_cond`` — a discovered lock whose name the lexical rule cannot
+    recognize, so this rule owns the finding.
+"""
+
+import threading
+import time
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.data = {}
+
+    def lookup(self, key):
+        with self._lock:
+            return self._fetch(key)  # BAD: blocks 2 hops down
+
+    def _fetch(self, key):
+        if key not in self.data:
+            self.data[key] = self._pull(key)
+        return self.data[key]
+
+    def _pull(self, key):
+        time.sleep(0.1)  # simulated slow origin fetch
+        return key
+
+
+class CondHolder:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def drain(self):
+        with self._cond:
+            time.sleep(0.01)  # BAD: direct block under a discovered lock

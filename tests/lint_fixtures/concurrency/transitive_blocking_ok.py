@@ -1,0 +1,60 @@
+"""Fixture: transitive-blocking-under-lock clean shapes (ISSUE 17).
+
+Blessed: the tree's standard snapshot-under-lock-act-after shape, the
+canonical ``Condition.wait`` loop (wait RELEASES the held condition,
+so it is exempt), and the justified-suppression protocol.
+"""
+
+import threading
+import time
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.data = {}
+
+    def lookup(self, key):
+        with self._lock:
+            hit = self.data.get(key)
+        if hit is None:  # slow path runs OUTSIDE the critical section
+            hit = self._pull(key)
+            with self._lock:
+                self.data[key] = hit
+        return hit
+
+    def _pull(self, key):
+        time.sleep(0.1)
+        return key
+
+
+class CondWaiter:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.items = []
+
+    def take(self):
+        with self._cond:
+            while not self.items:
+                self._cond.wait(timeout=0.1)  # releases _cond: exempt
+            return self.items.pop()
+
+    def put(self, x):
+        with self._cond:
+            self.items.append(x)
+            self._cond.notify_all()
+
+
+class DeliberateSerializer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def exclusive_pull(self, key):
+        with self._lock:
+            # distpow: ok transitive-blocking-under-lock -- the lock IS
+            # the serializer: exactly one puller per key by design
+            return self._pull(key)
+
+    def _pull(self, key):
+        time.sleep(0.1)
+        return key

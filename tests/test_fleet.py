@@ -23,7 +23,11 @@ from fleet_helpers import ShardGatedBackend as _ShardGatedBackend  # noqa: E402
 from test_nodes import Stack, mine_and_wait  # noqa: E402
 
 from distpow_tpu.backends import PythonBackend  # noqa: E402
-from distpow_tpu.fleet import Capability, FleetRegistry  # noqa: E402
+from distpow_tpu.fleet import (  # noqa: E402
+    Capability,
+    FleetRegistry,
+    WorkerLease,
+)
 from distpow_tpu.models import puzzle  # noqa: E402
 from distpow_tpu.nodes import Worker  # noqa: E402
 from distpow_tpu.nodes.coordinator import WorkerRef  # noqa: E402
@@ -75,6 +79,27 @@ def test_register_heartbeat_expire_cycle():
     assert metrics.get("fleet.lease_expiries") == before + 1
     with pytest.raises(KeyError):
         reg.heartbeat(grant["lease_id"])
+    reg.close()
+
+
+def test_is_stale_reads_beat_clock_under_registry_lock(monkeypatch):
+    """``is_stale`` must hold the registry lock while it reads the
+    beat clock: ``heartbeat()`` writes ``last_beat`` on RPC handler
+    threads, and the original bare read raced it (caught by
+    distpow-lint's unguarded-shared-write sweep, ISSUE 17)."""
+    reg, _ = _registry(0, lease_ttl_s=30.0)
+    reg.register("w1", "127.0.0.1:9300", Capability())
+    ref = reg.refs[0]
+    seen = []
+    real = WorkerLease.beat_age
+
+    def spying_beat_age(self, now):
+        seen.append(reg._lock.locked())
+        return real(self, now)
+
+    monkeypatch.setattr(WorkerLease, "beat_age", spying_beat_age)
+    assert reg.is_stale(ref, threshold_s=1e9) is False
+    assert seen and all(seen), "beat clock read without the registry lock"
     reg.close()
 
 

@@ -146,6 +146,24 @@ CASES = [
     ("unbounded-thread-spawn",
      os.path.join("cluster", "unbounded_thread_spawn_bad.py"),
      os.path.join("cluster", "unbounded_thread_spawn_ok.py"), 3),
+    # concurrency-discipline plane (ISSUE 17): guarded-by covers both
+    # tiers (annotation violations incl. a bare READ, discovered
+    # mixed locked/bare writes); the ok fixture proves entry-lock
+    # credit through a helper and the suppression protocol
+    ("unguarded-shared-write",
+     os.path.join("concurrency", "guarded_by_bad.py"),
+     os.path.join("concurrency", "guarded_by_ok.py"), 3),
+    # the inversion's a->b edge exists only through the call summary
+    # (indirect), b->a lexically; one finding per cycle, not per edge
+    ("lock-order-inversion",
+     os.path.join("concurrency", "lock_order_bad.py"),
+     os.path.join("concurrency", "lock_order_ok.py"), 1),
+    # blocking two call hops below the critical section + a direct
+    # block under a discovered Condition the lexical rule cannot name;
+    # the ok fixture blesses snapshot-then-act and the cond-wait loop
+    ("transitive-blocking-under-lock",
+     os.path.join("concurrency", "transitive_blocking_bad.py"),
+     os.path.join("concurrency", "transitive_blocking_ok.py"), 2),
 ]
 
 
@@ -268,3 +286,62 @@ def test_cli_list_rules_names_every_rule():
     for rule in ALL_RULES:
         assert rule.RULE_ID in out.stdout
     assert len(ALL_RULES) >= 7
+
+
+# -- baseline hygiene (ISSUE 17) ---------------------------------------------
+# A baseline entry that no longer matches any finding is itself a
+# ``stale-baseline`` finding: grandfathered debt must shrink, never rot.
+
+_BAD = os.path.join("tests", "lint_fixtures", "blocking_under_lock_bad.py")
+
+
+def _live_entries():
+    payload = json.loads(_cli(_BAD, "--json").stdout)
+    assert payload["findings"], "fixture must still produce findings"
+    return [{"rule": f["rule"], "path": f["path"], "message": f["message"]}
+            for f in payload["findings"]]
+
+
+def test_stale_baseline_entry_is_a_finding(tmp_path):
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({"findings": _live_entries() + [
+        {"rule": "silent-except", "path": "distpow_tpu/gone.py",
+         "message": "fixed long ago"}]}))
+    out = _cli(_BAD, "--baseline", str(base))
+    assert out.returncode == 1
+    assert "stale-baseline" in out.stdout
+    assert "gone.py" in out.stdout
+    # the live entries still grandfather their findings
+    assert "no-blocking-under-lock" not in out.stdout
+
+
+def test_live_baseline_still_grandfathers_cleanly(tmp_path):
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({"findings": _live_entries()}))
+    out = _cli(_BAD, "--baseline", str(base))
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_rewrite_baseline_prunes_only_stale_entries(tmp_path):
+    live = _live_entries()
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({
+        "_comment": "kept",
+        "findings": live + [{"rule": "silent-except",
+                             "path": "distpow_tpu/gone.py",
+                             "message": "fixed long ago"}]}))
+    out = _cli(_BAD, "--baseline", str(base), "--rewrite-baseline")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "pruned 1 stale" in out.stderr
+    data = json.loads(base.read_text())
+    assert data["_comment"] == "kept"
+    assert data["findings"] == live
+    # idempotent: a second rewrite changes nothing and stays clean
+    out2 = _cli(_BAD, "--baseline", str(base), "--rewrite-baseline")
+    assert out2.returncode == 0
+    assert "pruned" not in out2.stderr
+
+
+def test_rewrite_baseline_requires_baseline():
+    out = _cli(_BAD, "--rewrite-baseline")
+    assert out.returncode == 2
