@@ -108,6 +108,8 @@ class ProjectContext:
     counter_prefixes: Tuple[str, ...] = ()
     histograms: Set[str] = field(default_factory=set)
     histogram_prefixes: Tuple[str, ...] = ()
+    gauges: Set[str] = field(default_factory=set)
+    gauge_prefixes: Tuple[str, ...] = ()
     config_fields: Set[str] = field(default_factory=set)
 
 
@@ -267,6 +269,10 @@ def build_context(package_root: str) -> ProjectContext:
             )
             ctx.histogram_prefixes = tuple(sorted(
                 _string_set_from_assign(tree, "KNOWN_HISTOGRAM_PREFIXES")
+            ))
+            ctx.gauges = _string_set_from_assign(tree, "KNOWN_GAUGES")
+            ctx.gauge_prefixes = tuple(sorted(
+                _string_set_from_assign(tree, "KNOWN_GAUGE_PREFIXES")
             ))
     if os.path.exists(config_py):
         tree = _parse_file(config_py)

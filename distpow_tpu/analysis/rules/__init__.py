@@ -20,6 +20,7 @@ from . import (
     transitive_blocking,
     unbounded_thread_spawn,
     unclosed_span,
+    wall_clock_duration,
 )
 
 ALL_RULES = (
@@ -37,6 +38,7 @@ ALL_RULES = (
     hot_path_host_sync,
     relaunch_loop_sync,
     unclosed_span,
+    wall_clock_duration,
     silent_except,
     dead_package,
 )

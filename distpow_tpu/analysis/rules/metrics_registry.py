@@ -11,7 +11,10 @@ The registry is declared in ``runtime/metrics.py``:
   ``metrics.inc(...)`` / ``REGISTRY.inc(...)`` call sites;
 * ``KNOWN_HISTOGRAMS`` / ``KNOWN_HISTOGRAM_PREFIXES`` gate
   ``metrics.observe(...)`` and ``metrics.time(...)`` call sites (the
-  ISSUE-3 latency telemetry plane).
+  ISSUE-3 latency telemetry plane);
+* ``KNOWN_GAUGES`` / ``KNOWN_GAUGE_PREFIXES`` gate
+  ``metrics.gauge(...)`` call sites (the ISSUE-18 resource sentinels —
+  a typo'd gauge name is a leak detector watching nothing).
 
 Resolution, per call site:
 
@@ -32,13 +35,15 @@ from ._util import is_module, receiver_name, resolve_str_constant
 
 RULE_ID = "metrics-registry"
 DESCRIPTION = (
-    "metrics.inc()/observe()/time() series names must be declared in "
-    "runtime/metrics.py KNOWN_COUNTERS / KNOWN_HISTOGRAMS (+ prefixes)"
+    "metrics.inc()/observe()/time()/gauge() series names must be declared "
+    "in runtime/metrics.py KNOWN_COUNTERS / KNOWN_HISTOGRAMS / "
+    "KNOWN_GAUGES (+ prefixes)"
 )
 
 RECEIVERS = frozenset({"metrics", "REGISTRY"})
 COUNTER_METHODS = frozenset({"inc"})
 HISTOGRAM_METHODS = frozenset({"observe", "time"})
+GAUGE_METHODS = frozenset({"gauge"})
 
 
 def _series_arg(call: ast.Call) -> Optional[Tuple[str, ast.AST]]:
@@ -51,6 +56,8 @@ def _series_arg(call: ast.Call) -> Optional[Tuple[str, ast.AST]]:
         return "counter", call.args[0]
     if call.func.attr in HISTOGRAM_METHODS:
         return "histogram", call.args[0]
+    if call.func.attr in GAUGE_METHODS:
+        return "gauge", call.args[0]
     return None
 
 
@@ -64,6 +71,8 @@ def check(module, context) -> Iterator:
                     "KNOWN_COUNTERS", "KNOWN_COUNTER_PREFIXES"),
         "histogram": (context.histograms, context.histogram_prefixes,
                       "KNOWN_HISTOGRAMS", "KNOWN_HISTOGRAM_PREFIXES"),
+        "gauge": (context.gauges, context.gauge_prefixes,
+                  "KNOWN_GAUGES", "KNOWN_GAUGE_PREFIXES"),
     }
     for node in ast.walk(module.tree):
         if not isinstance(node, ast.Call):

@@ -13,7 +13,16 @@ difficulties, and optional PR 1 fault-plane chaos.
 * :mod:`.harness`  — an in-process cluster wired to the fleet scraper
   and SLO engine (distpow_tpu/obs/): run a mix, scrape the nodes,
   assert the objectives.  ``bench.py --load-slo`` and
-  ``scripts/ci.sh --slo-smoke`` are thin wrappers over this.
+  ``scripts/ci.sh --slo-smoke`` are thin wrappers over this;
+* :mod:`.shapes`   — seeded, pure time-varying rate schedules (ISSUE
+  18, docs/SOAK.md): diurnal sinusoid, flash crowd, linear ramp,
+  composable sums, and a wall-clock compression knob so an 8-hour
+  diurnal replays in CI minutes;
+* :mod:`.soak`     — the long-haul soak harness: shaped load + chaos +
+  time-series retention + leak sentinels, ending in a typed
+  :class:`~.soak.SoakVerdict` with the 0/1/2 exit-code contract.
+  ``python -m distpow_tpu.cli.soak``, ``bench.py --soak`` and
+  ``scripts/ci.sh --soak-smoke`` are thin wrappers over this.
 """
 
 from .loadgen import Arrival, LoadMix, OpenLoopRunner, build_schedule
@@ -23,6 +32,18 @@ from .harness import (
     percentile_within_one_bucket,
     run_load_slo,
 )
+from .shapes import (
+    Compressed,
+    Constant,
+    Diurnal,
+    FlashCrowd,
+    Ramp,
+    RateShape,
+    Sum,
+    build_shaped_schedule,
+    compress,
+)
+from .soak import PhaseVerdict, SoakVerdict, run_soak
 
 __all__ = [
     "Arrival",
@@ -33,4 +54,16 @@ __all__ = [
     "exact_percentile",
     "percentile_within_one_bucket",
     "run_load_slo",
+    "RateShape",
+    "Constant",
+    "Diurnal",
+    "FlashCrowd",
+    "Ramp",
+    "Sum",
+    "Compressed",
+    "compress",
+    "build_shaped_schedule",
+    "PhaseVerdict",
+    "SoakVerdict",
+    "run_soak",
 ]

@@ -31,6 +31,8 @@ from bisect import bisect_left
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from ..runtime.metrics import REGISTRY as metrics
+
 
 @dataclass(frozen=True)
 class LoadMix:
@@ -190,6 +192,11 @@ class OpenLoopRunner:
             if lag > rep.max_lag_s:
                 rep.max_lag_s = lag
             rep.lag_sum_s += max(0.0, lag)
+            # declared histogram, not just the report (ISSUE 18): a
+            # lagging generator silently converts open-loop into
+            # closed-loop, so the lag distribution must be visible to
+            # the scraper/soak verdict like any other series
+            metrics.observe("load.lag_s", max(0.0, lag))
         rep.wall_s = time.monotonic() - t0
         rep.offered_rate_hz = rep.issued / rep.wall_s if rep.wall_s else 0.0
         return rep

@@ -86,6 +86,7 @@ from ..runtime import actions as act
 from ..runtime.cache import ResultCache
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import CoordinatorConfig
+from ..runtime.health import SENTINELS
 from ..runtime.rpc import (
     RPCClient,
     RPCError,
@@ -1392,6 +1393,15 @@ class CoordRPCHandler:
     def Stats(self, params) -> dict:
         """Metrics snapshot (runtime/metrics.py; no reference
         equivalent).  ``python -m distpow_tpu.cli.stats`` prints it."""
+        # resource sentinels ride every Stats snapshot (runtime/health.py,
+        # docs/SOAK.md): proc.* self-telemetry plus the depth of every
+        # bounded ring, refreshed before the registry is read
+        repl_view = (self.replicator.stats_view()
+                     if self.replicator is not None else None)
+        if repl_view is not None:
+            metrics.gauge("ring.repl_queue_depth",
+                          float(repl_view.get("queue_depth", 0)))
+        SENTINELS.sample()
         snap = metrics.snapshot()
         snap["role"] = "coordinator"
         snap["workers"] = [
@@ -1415,8 +1425,8 @@ class CoordRPCHandler:
             # walks to cover the whole pool
             snap["cluster"] = {"self": self.cluster.self_id,
                                "ring": self.cluster.ring.to_wire()}
-        if self.replicator is not None:
-            snap["replication"] = self.replicator.stats_view()
+        if repl_view is not None:
+            snap["replication"] = repl_view
         snap["sched"] = {
             "max_inflight": self._sched_max_inflight,
             "coalesce": self._coalescer is not None,

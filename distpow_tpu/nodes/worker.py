@@ -47,6 +47,7 @@ from ..runtime import actions as act
 from ..runtime.cache import ResultCache
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.config import WorkerConfig
+from ..runtime.health import SENTINELS
 from ..runtime.rpc import RPCClient, RPCServer, StatsOnly
 from ..runtime.spans import SPANS
 from ..runtime.telemetry import RECORDER
@@ -382,6 +383,8 @@ class WorkerRPCHandler:
     def Stats(self, params) -> dict:
         """Metrics snapshot (runtime/metrics.py; no reference
         equivalent).  ``python -m distpow_tpu.cli.stats`` prints it."""
+        # resource sentinels ride every Stats snapshot (runtime/health.py)
+        SENTINELS.sample()
         snap = metrics.snapshot()
         snap["role"] = "worker"
         snap["backend"] = type(self.backend).__name__

@@ -19,7 +19,12 @@ top of it:
 * :mod:`.forensics` — per-request cross-node forensics (ISSUE 14,
   docs/FORENSICS.md): concurrent ``Node.Spans`` sweeps over the fleet
   and timeline stitching that names the shard/segment a slow Mine
-  spent its time in.
+  spent its time in;
+* :mod:`.timeseries` — bounded multi-resolution retention of merged
+  sweeps (ISSUE 18, docs/SOAK.md): tiered downsampling on the shared
+  log-bucket grid, windowed delta queries (the SLO engine's burn
+  windows read these), gauge trajectories for the leak sentinels, and
+  a rotated JSONL spool for post-mortem replay.
 
 Consumers: ``python -m distpow_tpu.cli.stats --cluster``, ``python -m
 distpow_tpu.cli.slo``, the open-loop load harness
@@ -37,6 +42,7 @@ from .slo import (
     SLOVerdict,
     load_slo_config,
 )
+from .timeseries import DEFAULT_TIERS, Tier, TimeSeriesStore, replay_spool
 
 __all__ = [
     "fetch_spans",
@@ -53,4 +59,8 @@ __all__ = [
     "SLOVerdict",
     "ObjectiveVerdict",
     "load_slo_config",
+    "Tier",
+    "TimeSeriesStore",
+    "DEFAULT_TIERS",
+    "replay_spool",
 ]

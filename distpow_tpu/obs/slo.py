@@ -41,7 +41,6 @@ from __future__ import annotations
 import json
 import os
 import time
-from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -54,7 +53,8 @@ from ..runtime.metrics import (
 from ..runtime.metrics import REGISTRY as metrics
 from ..runtime.spans import SPANS
 from ..runtime.telemetry import RECORDER
-from .merge import PER_MODEL_HISTOGRAM_PREFIX, delta_merged
+from .merge import PER_MODEL_HISTOGRAM_PREFIX
+from .timeseries import Tier, TimeSeriesStore
 
 _STATS = ("p50", "p95", "p99", "mean")
 _STATUS_RANK = {"pass": 0, "no_data": 0, "warn": 1, "breach": 2}
@@ -270,18 +270,31 @@ def _hist_stat(h: Optional[dict], stat: str) -> Optional[float]:
 
 
 class SLOEngine:
-    """Evaluate a :class:`SLOConfig` over a history of merged snapshots.
+    """Evaluate a :class:`SLOConfig` over retained merged snapshots.
 
     Feed every sweep through :meth:`observe` (or pass it straight to
-    :meth:`evaluate`); the engine keeps a bounded history ring and
-    resolves the fast/slow windows from it.  ``ts`` parameters exist
-    for deterministic tests — production callers omit them."""
+    :meth:`evaluate`); history lives in a
+    :class:`~distpow_tpu.obs.timeseries.TimeSeriesStore` (pass your own
+    ``store`` to share retention with a soak harness — the engine's
+    burn windows and the soak verdict's phase windows then read the
+    SAME points) and the fast/slow windows are the store's windowed
+    delta queries.  ``ts`` parameters exist for deterministic tests —
+    production callers omit them."""
 
     def __init__(self, config: SLOConfig, history: int = 512,
                  journal_path: Optional[str] = None,
-                 span_addrs: Optional[List[str]] = None):
+                 span_addrs: Optional[List[str]] = None,
+                 store: Optional[TimeSeriesStore] = None):
         self.config = config
-        self._history: "deque[Tuple[float, dict]]" = deque(maxlen=history)
+        # a private store sized to the burn windows when none is shared:
+        # full resolution across the slow window (plus slack), coarse
+        # beyond — `history` survives as the finest tier's point cap
+        # proxy via retention, so existing constructors keep working
+        self.store = store if store is not None else TimeSeriesStore(
+            tiers=(
+                Tier(0.0, max(2 * config.slow_window_s, 600.0)),
+                Tier(10.0, 3600.0),
+            ))
         self._journal_path = journal_path
         # where to fetch slow-request span trees from when THIS process
         # has no local ring evidence (the cli/slo.py gate judging a
@@ -291,25 +304,7 @@ class SLOEngine:
 
     # -- history ------------------------------------------------------------
     def observe(self, merged: dict, ts: Optional[float] = None) -> None:
-        self._history.append(
-            (float(ts if ts is not None else time.time()), merged))
-
-    def _window(self, now: float, window_s: float) -> Optional[dict]:
-        """Newest history snapshot at least ``window_s`` old.  When the
-        history is shallower than the window, the OLDEST entry stands in
-        (the widest window actually observed — for a short harness run
-        that is exactly the run window); with a single entry there is
-        nothing to delta against and the evaluation degrades to
-        cumulative (module docstring)."""
-        best = None
-        for ts, snap in self._history:
-            if ts <= now - window_s:
-                best = snap
-            else:
-                break
-        if best is None and len(self._history) > 1:
-            best = self._history[0][1]
-        return best
+        self.store.append(merged, ts if ts is not None else time.time())
 
     # -- evaluation ---------------------------------------------------------
     def evaluate(self, merged: Optional[dict] = None,
@@ -322,26 +317,42 @@ class SLOEngine:
         mid-run peeks must not dump on a transient warm-up spike)."""
         if merged is not None:
             self.observe(merged, ts)
-        if not self._history:
+        latest = self.store.latest()
+        if latest is None:
             raise ValueError("no merged snapshot to evaluate")
         metrics.inc("slo.evaluations")
-        now, latest = self._history[-1]
-        fast_old = self._window(now, self.config.fast_window_s)
-        slow_old = self._window(now, self.config.slow_window_s)
-        fast = delta_merged(latest, fast_old)
-        slow = delta_merged(latest, slow_old)
+        now = latest[0]
+        fast = self.store.window(self.config.fast_window_s, now) or latest[1]
+        slow = self.store.window(self.config.slow_window_s, now) or latest[1]
+        verdict = self._judge_windows(fast, slow, now,
+                                      latest[1].get("stale_nodes") or [])
+        if verdict.status == "breach" and breach_hooks:
+            self._on_breach(verdict)
+        return verdict
+
+    def judge_range(self, start_ts: float, end_ts: float) -> SLOVerdict:
+        """Judge every objective over ONE historical window — the delta
+        between the retained snapshots at ``end_ts`` and ``start_ts``
+        (both resolved by the store's snapshot_at contract).  Fast and
+        slow collapse to the same window: a phase is judged as a whole,
+        not as a burn rate.  No breach side effects — the soak verdict
+        (load/soak.py) aggregates these per shape phase and carries its
+        own evidence hooks."""
+        win = self.store.range_window(start_ts, end_ts)
+        if win is None:
+            raise ValueError("no retained snapshot inside the range")
+        return self._judge_windows(win, win, end_ts,
+                                   win.get("stale_nodes") or [])
+
+    def _judge_windows(self, fast: dict, slow: dict, now: float,
+                       stale_nodes) -> SLOVerdict:
         verdicts: List[ObjectiveVerdict] = []
         for obj in self.config.objectives:
             verdicts.extend(self._judge(obj, fast, slow))
         status = max((v.status for v in verdicts),
                      key=lambda s: _STATUS_RANK[s], default="pass")
-        verdict = SLOVerdict(
-            status=status, objectives=verdicts, ts=now,
-            stale_nodes=list(latest.get("stale_nodes") or []),
-        )
-        if status == "breach" and breach_hooks:
-            self._on_breach(verdict)
-        return verdict
+        return SLOVerdict(status=status, objectives=verdicts, ts=now,
+                          stale_nodes=list(stale_nodes))
 
     def _judge(self, obj: Objective, fast: dict,
                slow: dict) -> List[ObjectiveVerdict]:

@@ -124,6 +124,17 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
   acked)
 * ``repl.antientropy_rounds`` — anti-entropy digest-exchange sweeps
   completed against the ring successors
+* ``health.leak_suspects`` — resource gauges the trend detector judged
+  monotone-climbing past the noise floor (runtime/health.py; each also
+  records a ``health.leak_suspect`` flight-recorder event —
+  docs/SOAK.md "Sentinels")
+* ``soak.sweeps`` — fleet sweeps the soak harness ingested into the
+  time-series store (distpow_tpu/load/soak.py, docs/SOAK.md)
+* ``soak.phase_breaches`` — shape phases whose windowed SLO judgment
+  breached during a soak (one per failing phase, not per objective)
+* ``obs.spool_rotations`` — time-series JSONL spool segments rotated
+  out by the size cap (distpow_tpu/obs/timeseries.py; same rotation
+  machinery as the flight-recorder journal)
 
 Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 ``KNOWN_HISTOGRAM_PREFIXES`` vs ``observe()``/``time()`` call sites):
@@ -163,13 +174,31 @@ Histogram names in use (same machine check, ``KNOWN_HISTOGRAMS`` /
 * ``rpc.frame.sent_bytes`` / ``rpc.frame.recv_bytes`` — wire frame sizes
 * ``rpc.client.call_s.<Service.Method>``     — per-method round-trip
 * ``rpc.server.dispatch_s.<Service.Method>`` — per-method handler time
+* ``load.lag_s`` — open-loop generator lag: how far behind its seeded
+  Poisson schedule each arrival fired (distpow_tpu/load/loadgen.py; a
+  lagging generator silently converts open-loop into closed-loop, so
+  the soak verdict judges this distribution — docs/SOAK.md)
 
-Gauges (not lint-gated — gauges are set, never minted by typo'd
-increments): ``worker.active_searches``, ``worker.mine_queue_depth``,
-``worker.forward_queue_depth``, ``search.hashes_per_s``,
-``sched.active_slots``, ``sched.run_queue_depth``,
-``fleet.live_workers`` (coordinator-side count of non-draining
-members, static and elastic alike — distpow_tpu/fleet/membership.py).
+Gauge names in use (``KNOWN_GAUGES`` below; lint-gated since the soak
+plane made gauges load-bearing — a typo'd sentinel gauge would hide a
+leak from the trend detector exactly when it matters):
+
+* ``worker.active_searches`` / ``worker.mine_queue_depth`` /
+  ``worker.forward_queue_depth`` — worker occupancy and bounded-queue
+  depths (nodes/worker.py)
+* ``search.hashes_per_s``  — rolling backend throughput
+* ``sched.active_slots`` / ``sched.run_queue_depth`` — continuous-
+  batching occupancy and bounded run-queue depth (sched/engine.py)
+* ``fleet.live_workers``   — coordinator-side count of non-draining
+  members, static and elastic alike (distpow_tpu/fleet/membership.py)
+* ``proc.rss_bytes`` / ``proc.open_fds`` / ``proc.threads`` — per-node
+  self-telemetry sampled on every Stats snapshot (runtime/health.py;
+  the soak plane's leak sentinels watch these — docs/SOAK.md)
+* ``ring.spans_depth`` / ``ring.flightrec_depth`` /
+  ``ring.repl_queue_depth`` — occupancy of the bounded rings the repo
+  owns (span ring, flight-recorder ring, replication push queue);
+  forwarder backlog and sched run queue already ship as the
+  ``*_queue_depth`` gauges above
 """
 
 from __future__ import annotations
@@ -220,6 +249,9 @@ KNOWN_COUNTERS = frozenset({
     "cluster.reroutes", "cluster.failovers", "cluster.sibling_hedges",
     "repl.pushes", "repl.push_failures", "repl.installs",
     "repl.stale_drops", "repl.handoff_keys", "repl.antientropy_rounds",
+    "health.leak_suspects",
+    "soak.sweeps", "soak.phase_breaches",
+    "obs.spool_rotations",
 })
 
 # Families minted from runtime values (f-string call sites): the
@@ -243,6 +275,7 @@ KNOWN_HISTOGRAMS = frozenset({
     "fleet.heartbeat_rtt_s",
     "cluster.failover_s",
     "repl.push_lag_s", "repl.handoff_s",
+    "load.lag_s",
 })
 
 # Per-method families (runtime/rpc.py mints one histogram per
@@ -252,6 +285,24 @@ KNOWN_HISTOGRAM_PREFIXES = frozenset({
     "rpc.server.dispatch_s.",
     "worker.solve_s.",  # per-hash-model solve latency (nodes/worker.py)
 })
+
+# The declared gauge registry — lint-gated like counters since the
+# leak sentinels (runtime/health.py) made gauge NAMES load-bearing: a
+# typo'd ``metrics.gauge("…")`` would split a climbing resource gauge
+# away from the trend detector watching the declared name.
+KNOWN_GAUGES = frozenset({
+    "worker.active_searches", "worker.mine_queue_depth",
+    "worker.forward_queue_depth",
+    "search.hashes_per_s",
+    "sched.active_slots", "sched.run_queue_depth",
+    "fleet.live_workers",
+    "proc.rss_bytes", "proc.open_fds", "proc.threads",
+    "ring.spans_depth", "ring.flightrec_depth", "ring.repl_queue_depth",
+})
+
+# No gauge families are minted from runtime values today; the empty
+# declaration keeps the lint context explicit (and greppable) anyway.
+KNOWN_GAUGE_PREFIXES = frozenset()
 
 # Log-bucket geometry: 4 buckets per octave (bounds grow by 2^0.25, so a
 # bucket is at most ~19% wide) — fine enough for honest p95/p99
@@ -395,7 +446,7 @@ class Metrics:
         self._gauges: Dict[str, Number] = {}
         self._hists: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
-        self._start = time.time()
+        self._start = time.monotonic()
         # exemplar capture switch (docs/FORENSICS.md): call sites pass
         # trace ids unconditionally; flipping this off drops them at
         # the registry so bench.py --forensics-overhead can measure
@@ -440,7 +491,7 @@ class Metrics:
     def snapshot(self) -> dict:
         with self._lock:
             return {
-                "uptime_secs": round(time.time() - self._start, 3),
+                "uptime_secs": round(time.monotonic() - self._start, 3),
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "histograms": {
@@ -454,7 +505,7 @@ class Metrics:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
-            self._start = time.time()
+            self._start = time.monotonic()
 
 
 REGISTRY = Metrics()

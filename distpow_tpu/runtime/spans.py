@@ -259,6 +259,12 @@ class SpanRecorder:
             })
 
     # -- reading ------------------------------------------------------------
+    def depth(self) -> int:
+        """Current ring occupancy — the ``ring.spans_depth`` gauge the
+        resource sentinels export (runtime/health.py)."""
+        with self._lock:
+            return len(self._spans)
+
     @property
     def total_recorded(self) -> int:
         """Monotonic count of spans ever recorded — the delta source

@@ -57,6 +57,68 @@ DEFAULT_JOURNAL_MAX_BYTES = 8 * 1024 * 1024
 DEFAULT_JOURNAL_KEEP = 3
 
 
+def rotate_if_over(path: str, max_bytes: int, keep: int) -> bool:
+    """Size-capped JSONL rotation shared by every append-only spool the
+    repo writes (flight-recorder journal here; the time-series spool in
+    obs/timeseries.py): once the live file at ``path`` reaches
+    ``max_bytes``, shift ``path.(i)`` -> ``path.(i+1)`` (dropping
+    segments beyond ``keep``) and the live file to ``path.1``, bounding
+    total disk at ~(keep + 1) x max_bytes.  Returns True when a
+    rotation happened.  Best-effort: a failed rename costs rotation,
+    never the caller's appends.  Callers serialize against their own
+    appends (renames are bounded local metadata operations — the
+    FileSink discipline)."""
+    if max_bytes <= 0:
+        return False
+    try:
+        if os.path.getsize(path) < max_bytes:
+            return False
+        keep = max(0, int(keep))
+        oldest = f"{path}.{keep}"
+        if keep == 0:
+            os.remove(path)
+            return True
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(keep - 1, 0, -1):
+            seg = f"{path}.{i}"
+            if os.path.exists(seg):
+                os.replace(seg, f"{path}.{i + 1}")
+        os.replace(path, f"{path}.1")
+        return True
+    except OSError as exc:
+        log.warning("journal rotation failed for %s: %s", path, exc)
+        return False
+
+
+def iter_rotated_jsonl(path: str):
+    """Yield parsed JSON objects from a rotated spool, oldest segment
+    first (``path.N`` ... ``path.1``, then the live file), skipping
+    lines that fail to parse (a crash mid-append leaves at most one
+    torn tail line per segment)."""
+    segments = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        segments.append(f"{path}.{i}")
+        i += 1
+    segments.reverse()
+    if os.path.exists(path):
+        segments.append(path)
+    for seg in segments:
+        try:
+            with open(seg) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except ValueError:
+                        continue
+        except OSError as exc:
+            log.warning("spool segment unreadable: %s: %s", seg, exc)
+
+
 class FlightRecorder:
     """Bounded ring of annotated events with JSONL journaling and
     dump-on-fault snapshots (module docstring)."""
@@ -97,6 +159,12 @@ class FlightRecorder:
         with self._lock:
             evs = list(self._events)
         return evs if n is None else evs[-n:]
+
+    def depth(self) -> int:
+        """Current ring occupancy — the ``ring.flightrec_depth`` gauge
+        the resource sentinels export (runtime/health.py)."""
+        with self._lock:
+            return len(self._events)
 
     # -- configuration ------------------------------------------------------
     def configure(self, journal_path: Optional[str] = None,
@@ -193,32 +261,11 @@ class FlightRecorder:
             self._maybe_rotate_locked(path)
 
     def _maybe_rotate_locked(self, path: str) -> None:
-        """Size-capped rotation (module constants): once the live
-        journal exceeds the byte cap, shift ``path.(i)`` -> ``path.(i+1)``
-        (dropping segments beyond the keep count) and the live file to
-        ``path.1``.  Runs under the ring lock right after a successful
-        append — renames are bounded local metadata operations, the
-        FileSink discipline — so a racing flush can neither double-rotate
-        nor append to a mid-rotation file.  Best-effort like the append:
-        a failed rename costs rotation, never events."""
-        if self._journal_max_bytes <= 0:
-            return
-        try:
-            if os.path.getsize(path) < self._journal_max_bytes:
-                return
-            oldest = f"{path}.{self._journal_keep}"
-            if self._journal_keep == 0:
-                os.remove(path)
-                return
-            if os.path.exists(oldest):
-                os.remove(oldest)
-            for i in range(self._journal_keep - 1, 0, -1):
-                seg = f"{path}.{i}"
-                if os.path.exists(seg):
-                    os.replace(seg, f"{path}.{i + 1}")
-            os.replace(path, f"{path}.1")
-        except OSError as exc:
-            log.warning("flight-recorder journal rotation failed: %s", exc)
+        """Size-capped rotation via the shared :func:`rotate_if_over`.
+        Runs under the ring lock right after a successful append so a
+        racing flush can neither double-rotate nor append to a
+        mid-rotation file."""
+        rotate_if_over(path, self._journal_max_bytes, self._journal_keep)
 
     # -- dump-on-fault ------------------------------------------------------
     def dump(self, reason: str, dump_dir: Optional[str] = None,

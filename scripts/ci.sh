@@ -61,13 +61,20 @@
 # Node.Spans sweep must stitch a timeline naming the delayed worker's
 # shard; trace_check must still report 0 violations — ~15 s, CPU,
 # no jax.
+# `--soak-smoke` runs the long-haul soak gate smoke
+# (scripts/soak_smoke.py, docs/SOAK.md): a seeded COMPRESSED
+# diurnal+flash-crowd "day" on an in-process cluster with chaos on
+# must end in a green SoakVerdict (every phase SLO-clean, zero leak
+# suspects, bounded ring drops/lag) with a replayable JSONL spool,
+# and a PLANTED thread-per-request leak must flip the verdict nonzero
+# naming proc.threads — ~90 s, CPU.
 # `--race-audit` runs the concurrency suites (fleet, cluster, sched,
 # chaos matrix, lockcheck's own tests) under the RUNTIME lock-order
 # audit (DISTPOW_LOCK_CHECK=1, runtime/lockcheck.py): every repo lock
 # acquisition is recorded into an order graph and the session FAILS on
 # any observed inversion — the dynamic twin of the static
 # lock-order-inversion rule (docs/CONCURRENCY.md) — ~2 min, CPU.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--race-audit|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--race-audit|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--soak-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -131,6 +138,13 @@ if [ "${1:-}" = "--slo-smoke" ]; then
   echo "=== SLO gate smoke (open-loop load + cluster merge + breach evidence) ==="
   JAX_PLATFORMS=cpu python scripts/slo_smoke.py
   echo "=== slo smoke OK ==="
+  exit 0
+fi
+
+if [ "${1:-}" = "--soak-smoke" ]; then
+  echo "=== soak gate smoke (compressed diurnal+flash day + planted leak) ==="
+  JAX_PLATFORMS=cpu python scripts/soak_smoke.py
+  echo "=== soak smoke OK ==="
   exit 0
 fi
 

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Deterministic soak-gate smoke (scripts/ci.sh --soak-smoke; docs/SOAK.md).
+
+Proves the long-haul soak plane end to end on CPU, in-process, in about
+ninety seconds of wall clock:
+
+1. GREEN arm: replay a seeded COMPRESSED diurnal-plus-flash-crowd shape
+   (one "day" squeezed into CI time) against a real cluster with the
+   canned chaos plan installed.  Every shape phase must hold the SLO,
+   zero leak suspects, ring drops and generator lag within budget —
+   verdict exit 0 — and the JSONL spool must be written AND replayable
+   (``obs.timeseries.replay_spool`` round-trips every retained sweep);
+2. LEAK arm: the same harness with a PLANTED leak — the client's mine
+   path is wrapped to park one daemon thread per request, the classic
+   slow executor leak.  The trend sentinel must turn the climbing
+   ``proc.threads`` gauge into a leak suspect and the verdict must exit
+   NONZERO naming that gauge — the smoke proves the gate FAILS when the
+   fleet is actually leaking.
+
+Prints one JSON summary line on stdout (details to stderr); exits 0
+only when BOTH arms held — the shape scripts/chaos_smoke.py
+established for CI lanes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distpow_tpu.cli.soak import CHAOS_SPEC  # noqa: E402
+from distpow_tpu.load import InProcCluster, LoadMix, run_soak  # noqa: E402
+from distpow_tpu.load.shapes import (  # noqa: E402
+    Diurnal,
+    FlashCrowd,
+    Sum,
+    compress,
+)
+from distpow_tpu.obs.timeseries import replay_spool  # noqa: E402
+
+#: green-arm wall clock (minutes) — one compressed "day"
+MINUTES = float(os.environ.get("SOAK_SMOKE_MINUTES", "1.0"))
+COMPRESS = float(os.environ.get("SOAK_SMOKE_COMPRESS", "320"))
+GREEN_CONFIG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "config", "slo.json")
+
+
+def canonical_shape(minutes: float):
+    """The CLI's default soak shape: diurnal day + flash crowd at 55%
+    of it, compressed into ``minutes`` of wall clock."""
+    day_s = minutes * 60.0 * COMPRESS
+    return compress(Sum(parts=(
+        Diurnal(base=6.0 / COMPRESS, amplitude=4.0 / COMPRESS,
+                period_s=day_s),
+        FlashCrowd(extra_hz=18.0 / COMPRESS, at_s=day_s * 0.55,
+                   width_s=day_s * 0.08, duration_s=day_s),
+    )), COMPRESS)
+
+
+def mix(seed: int) -> LoadMix:
+    return LoadMix(rate_hz=1.0, duration_s=1.0, seed=seed, n_keys=24,
+                   zipf_s=1.1, difficulties=((1, 0.7), (2, 0.3)))
+
+
+def green_arm(td: str) -> dict:
+    spool = os.path.join(td, "soak_spool.jsonl")
+    report, verdict = run_soak(
+        canonical_shape(MINUTES), mix(1805), GREEN_CONFIG,
+        n_workers=2, scrape_interval_s=1.0,
+        fault_spec=CHAOS_SPEC, spool_path=spool,
+    )
+    replayed = list(replay_spool(spool))
+    print(f"[soak-smoke] green: verdict={verdict.status} "
+          f"exit={verdict.exit_code()}, "
+          f"{len(verdict.phases)} phase(s), "
+          f"{len(replayed)} spooled sweep(s), "
+          f"lag p99 {verdict.lag_p99_s:.3f}s", file=sys.stderr)
+    for line in verdict.render().splitlines():
+        print(f"[soak-smoke]   {line}", file=sys.stderr)
+    return {
+        "status": verdict.status,
+        "exit": verdict.exit_code(),
+        "phases": [(p.name, p.status) for p in verdict.phases],
+        "spooled": len(replayed),
+        "replay_ok": bool(replayed)
+        and all("nodes" in m for _, m in replayed),
+        "lag_p99_s": verdict.lag_p99_s,
+        "failures": verdict.failures,
+    }
+
+
+def leak_arm(td: str) -> dict:
+    """Plant the classic executor leak — one parked daemon thread per
+    request — and require the sentinel to convict ``proc.threads``."""
+    cluster = InProcCluster(n_workers=2)
+    parked: list = []
+    stop = threading.Event()
+    real_mine = cluster.client.mine
+
+    def leaky_mine(*a, **kw):
+        t = threading.Thread(target=stop.wait, daemon=True)
+        t.start()
+        parked.append(t)
+        return real_mine(*a, **kw)
+
+    cluster.client.mine = leaky_mine
+    try:
+        shape = compress(
+            Diurnal(base=8.0 / COMPRESS, amplitude=2.0 / COMPRESS,
+                    period_s=20.0 * COMPRESS),
+            COMPRESS)
+        report, verdict = run_soak(
+            shape, mix(1806), GREEN_CONFIG,
+            cluster=cluster, scrape_interval_s=1.0,
+        )
+    finally:
+        stop.set()
+        time.sleep(0.05)
+        cluster.close()
+    named = [s["gauge"] for s in verdict.leak_suspects]
+    print(f"[soak-smoke] leak: verdict={verdict.status} "
+          f"exit={verdict.exit_code()}, planted {len(parked)} thread(s), "
+          f"suspects={named}", file=sys.stderr)
+    return {
+        "status": verdict.status,
+        "exit": verdict.exit_code(),
+        "planted_threads": len(parked),
+        "suspects": named,
+        "failures": verdict.failures,
+    }
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as td:
+        green = green_arm(td)
+        leak = leak_arm(td)
+        summary = {"green": green, "leak": leak}
+        print(json.dumps(summary))
+        if green["exit"] != 0:
+            print(f"[soak-smoke] FAIL: green soak did not pass: "
+                  f"{green['failures']}", file=sys.stderr)
+            return 1
+        if not green["replay_ok"] or green["spooled"] == 0:
+            print("[soak-smoke] FAIL: spool missing or not replayable",
+                  file=sys.stderr)
+            return 1
+        if leak["exit"] == 0:
+            print("[soak-smoke] FAIL: planted thread leak went "
+                  "unconvicted", file=sys.stderr)
+            return 1
+        if "proc.threads" not in leak["suspects"]:
+            print(f"[soak-smoke] FAIL: sentinel convicted "
+                  f"{leak['suspects']}, not proc.threads",
+                  file=sys.stderr)
+            return 1
+        print("[soak-smoke] OK: green day passes with chaos on; planted "
+              "leak convicted by name", file=sys.stderr)
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,3 +13,5 @@ def hot_path(kind, dt):
     metrics.observe("worker.solve", dt)  # line 13: typo of worker.solve_s
     with metrics.time(f"rpc.mystery_s.{kind}"):  # line 14: bad prefix
         pass
+    metrics.gauge("proc.rss_byte", dt)  # line 16: typo of proc.rss_bytes
+    REGISTRY.gauge(f"ring.{kind}_depth", dt)  # line 17: no gauge prefixes

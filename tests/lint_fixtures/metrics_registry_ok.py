@@ -16,6 +16,9 @@ def hot_path(kind, dt, dynamic_name):
     metrics.observe(f"rpc.client.call_s.{kind}", dt)
     with metrics.time("powlib.mine_s"):
         pass
+    metrics.gauge("proc.rss_bytes", dt)
+    metrics.gauge("ring.spans_depth", dt)
     # fully dynamic names are a documented limitation, not a finding
     metrics.inc(dynamic_name)
     metrics.observe(dynamic_name, dt)
+    metrics.gauge(dynamic_name, dt)

@@ -1,0 +1,30 @@
+"""Fixture: wall-clock-duration must flag time.time() deltas."""
+
+import time
+
+BOOT_TS = time.time()
+
+
+def direct(work):
+    start = time.time()
+    work()
+    return time.time() - start  # line 11: direct wall operand
+
+
+def local_name(work):
+    t0 = time.time()
+    work()
+    t1 = time.time()
+    return t1 - t0  # line 18: both operands are tainted locals
+
+
+class Timer:
+    def start(self):
+        self._t0 = time.time()
+
+    def elapsed(self):
+        return time.time() - self._t0  # line 26: attr carries wall taint
+
+
+def against_module_anchor():
+    return time.time() - BOOT_TS  # line 30: module-level tainted name

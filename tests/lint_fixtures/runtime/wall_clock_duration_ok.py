@@ -1,0 +1,33 @@
+"""Fixture: clocks wall-clock-duration must accept."""
+
+import time
+
+
+def monotonic_duration(work):
+    t0 = time.monotonic()
+    work()
+    return time.monotonic() - t0
+
+
+def wall_stamp_only(record):
+    # stamping a record with wall time is fine — no delta computed
+    record["ts"] = round(time.time(), 6)
+    return record
+
+
+def mixed_discipline(work):
+    # the shipped idiom: wall for the stamp, monotonic for the delta
+    ts = time.time()
+    t0 = time.monotonic()
+    work()
+    return {"ts": ts, "dt": time.monotonic() - t0}
+
+
+def cross_node_age(snapshot_ts):
+    # judging a remote node's wall stamp: no shared monotonic epoch
+    # exists, so wall-vs-wall is the only possible comparison
+    return time.time() - snapshot_ts  # distpow: ok wall-clock-duration -- staleness vs a REMOTE wall stamp; no shared monotonic epoch exists across processes
+
+
+def arithmetic_on_untainted(a, b):
+    return a - b

@@ -716,3 +716,69 @@ def test_finalize_cluster_scale_only_attaches_cache_ha(bench):
     assert line["unit"] == "x"
     assert "4-coordinator pool" in line["metric"]
     assert line["cache_ha"] == CH
+
+
+# -- soak stage (ISSUE 18) ----------------------------------------------------
+
+SK = {
+    "slo_config": "config/slo.json", "duration_s": 8.0, "rate_hz": 10.0,
+    "sweep_interval_s": 0.25,
+    "arms": [
+        {"arm": "off", "achieved_solves_per_s": 9.8, "completed": 80,
+         "request_errors": 0, "retained_points": 2, "verdict": "pass"},
+        {"arm": "on", "achieved_solves_per_s": 9.6, "completed": 78,
+         "request_errors": 0, "retained_points": 34, "verdict": "pass"},
+    ],
+    "on_solves_per_s": 9.6, "off_solves_per_s": 9.8,
+    "overhead_pct": 2.04, "overhead_ok": True, "ok": True, "wall_s": 21.0,
+}
+
+
+def test_finalize_attaches_soak_row(bench):
+    """The soak stage rides both artifacts of a normal run, like the
+    other tunnel-independent rows."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_FULL, 5.35e6, soak=SK
+    )
+    assert line["soak"] == SK
+    assert prov["soak"] == SK
+    assert line["unit"] == "MH/s"
+
+
+def test_finalize_soak_only_run(bench):
+    """bench.py --soak: the headline is the sweep-overhead percentage
+    and kernel provenance is NOT re-stamped."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None, soak=SK)
+    assert prov is None
+    assert line["unit"] == "%"
+    assert line["value"] == 2.04
+    assert "sweep overhead" in line["metric"]
+    assert line["soak"] == SK
+
+
+def test_finalize_carries_forward_soak(bench):
+    lm = dict(LAST_FULL, soak=SK)
+    line, prov = bench.finalize_record({"serving": 9800.0e6}, lm, 5.35e6)
+    assert prov["soak"] == SK
+    assert "soak" not in line
+
+
+def test_finalize_control_plane_headline_attaches_soak(bench):
+    """Device-unreachable runs that measured both CPU stages: the
+    control-plane row stays the headline, soak rides along."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, control_plane=CP, soak=SK
+    )
+    assert prov is None
+    assert line["unit"] == "ms"
+    assert line["soak"] == SK
+
+
+def test_finalize_cache_ha_only_attaches_soak(bench):
+    """A cache-HA-headline run still carries the soak dict."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, cache_ha=CH, soak=SK
+    )
+    assert prov is None
+    assert line["unit"] == "ratio"
+    assert line["soak"] == SK

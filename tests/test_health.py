@@ -1,0 +1,179 @@
+"""Resource sentinels and the leak trend detector
+(distpow_tpu/runtime/health.py, ISSUE 18): probe registration is
+policed against KNOWN_GAUGES, sampling sets the declared gauges, and
+the least-squares detector flags a planted linear climb while staying
+quiet on noisy-but-flat and oscillating trajectories."""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import pytest
+
+from distpow_tpu.obs.timeseries import TimeSeriesStore, Tier
+from distpow_tpu.runtime.health import (
+    SENTINELS,
+    LeakSentinel,
+    ResourceSentinels,
+    least_squares_slope,
+    open_fds,
+    rss_bytes,
+)
+from distpow_tpu.runtime.metrics import KNOWN_GAUGES, REGISTRY as metrics
+from distpow_tpu.runtime.telemetry import RECORDER
+
+T0 = 1_000_000.0
+
+
+def gauge_store(name, values, dt=1.0):
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9),))
+    for i, v in enumerate(values):
+        store.append({"ts": T0 + i * dt, "nodes": 1, "counters": {},
+                      "gauges": {name: float(v)}, "per_node": {},
+                      "per_model": {}, "stale_nodes": []})
+    return store
+
+
+# -- sentinel probes ---------------------------------------------------------
+
+def test_process_probes_return_positive_on_linux():
+    assert rss_bytes() and rss_bytes() > 0
+    assert open_fds() and open_fds() > 0
+
+
+def test_sample_sets_every_supported_declared_gauge():
+    out = SENTINELS.sample()
+    for name in ("proc.rss_bytes", "proc.open_fds", "proc.threads",
+                 "ring.spans_depth", "ring.flightrec_depth"):
+        assert name in out, f"probe {name} did not sample"
+        assert name in KNOWN_GAUGES
+    assert out["proc.threads"] >= 1.0
+    snap = metrics.snapshot()
+    assert snap["gauges"]["proc.rss_bytes"] == out["proc.rss_bytes"]
+
+
+def test_register_probe_rejects_undeclared_gauge():
+    s = ResourceSentinels()
+    with pytest.raises(ValueError, match="KNOWN_GAUGES"):
+        s.register_probe("proc.typo_bytes", lambda: 1.0)
+
+
+def test_failing_probe_skips_its_gauge_not_the_sample():
+    s = ResourceSentinels()
+
+    def boom():
+        raise RuntimeError("probe exploded")
+
+    s.register_probe("ring.repl_queue_depth", boom)
+    out = s.sample()
+    assert "ring.repl_queue_depth" not in out
+    assert "proc.threads" in out
+
+
+# -- least-squares slope -----------------------------------------------------
+
+def test_slope_exact_on_a_line():
+    series = [(T0 + i, 3.0 + 2.5 * i) for i in range(10)]
+    assert least_squares_slope(series) == pytest.approx(2.5)
+
+
+def test_slope_none_on_degenerate_series():
+    assert least_squares_slope([]) is None
+    assert least_squares_slope([(T0, 1.0)]) is None
+    assert least_squares_slope([(T0, 1.0), (T0, 9.0)]) is None
+
+
+# -- trend detector ----------------------------------------------------------
+
+def test_planted_linear_leak_is_flagged():
+    sentinel = LeakSentinel(window_s=1e9, min_points=6, noise_floor=2.0)
+    series = [(T0 + i, 10.0 + 0.5 * i) for i in range(30)]  # +14.5 total
+    suspect = sentinel.judge_series("proc.threads", series)
+    assert suspect is not None
+    assert suspect.gauge == "proc.threads"
+    assert suspect.slope_per_s == pytest.approx(0.5)
+    assert suspect.rise == pytest.approx(14.5)
+    assert suspect.points == 30
+
+
+def test_noisy_but_flat_gauge_stays_quiet():
+    rng = random.Random(1810)
+    sentinel = LeakSentinel(window_s=1e9, min_points=6, noise_floor=2.0)
+    series = [(T0 + i, 40.0 + rng.uniform(-3.0, 3.0)) for i in range(60)]
+    assert sentinel.judge_series("proc.threads", series) is None
+
+
+def test_oscillation_with_rising_endpoints_stays_quiet():
+    # a sawtooth whose fitted line technically climbs: the monotone-step
+    # fraction gate keeps it quiet
+    series = [(T0 + i, 20.0 + (6.0 if i % 2 else 0.0) + 0.05 * i)
+              for i in range(40)]
+    sentinel = LeakSentinel(window_s=1e9, min_points=6, noise_floor=1.0,
+                            min_monotone_frac=0.7)
+    assert sentinel.judge_series("proc.threads", series) is None
+
+
+def test_min_points_and_noise_floor_gates():
+    sentinel = LeakSentinel(window_s=1e9, min_points=6, noise_floor=10.0)
+    short = [(T0 + i, i * 5.0) for i in range(5)]
+    assert sentinel.judge_series("proc.threads", short) is None
+    shallow = [(T0 + i, 10.0 + 0.1 * i) for i in range(30)]  # rise 2.9
+    assert sentinel.judge_series("proc.threads", shallow) is None
+
+
+def test_check_sweeps_store_with_side_effects_and_dedup():
+    store = gauge_store("proc.threads", [12.0 + 1.5 * i for i in range(20)])
+    sentinel = LeakSentinel(window_s=1e9, min_points=6, noise_floor=2.0)
+    before = metrics.snapshot()["counters"].get("health.leak_suspects", 0)
+
+    suspects = sentinel.check(store)
+    assert [s.gauge for s in suspects] == ["proc.threads"]
+    after = metrics.snapshot()["counters"].get("health.leak_suspects", 0)
+    assert after == before + 1
+    events = [e for e in RECORDER.recent()
+              if e["kind"] == "health.leak_suspect"
+              and e["gauge"] == "proc.threads"]
+    assert events and events[-1]["points"] == 20
+
+    # a leak stays leaking: the suspect is re-reported, the counter and
+    # flight-recorder event are not re-fired for the same gauge
+    again = sentinel.check(store)
+    assert [s.gauge for s in again] == ["proc.threads"]
+    assert metrics.snapshot()["counters"]["health.leak_suspects"] == after
+
+
+def test_check_respects_per_gauge_noise_floors():
+    store = gauge_store("proc.open_fds", [50.0 + i for i in range(20)])
+    sentinel = LeakSentinel(window_s=1e9, min_points=6, noise_floor=2.0)
+    quiet = sentinel.check(store, gauges=["proc.open_fds"],
+                           noise_floors={"proc.open_fds": 1000.0})
+    assert quiet == []
+    loud = sentinel.check(store, gauges=["proc.open_fds"],
+                          noise_floors={"proc.open_fds": 5.0})
+    assert [s.gauge for s in loud] == ["proc.open_fds"]
+    # the floor override must not stick to the sentinel
+    assert sentinel.noise_floor == 2.0
+
+
+def test_check_defaults_to_proc_and_ring_gauges_in_store():
+    store = gauge_store("worker.forward_queue_depth",
+                        [float(i * 10) for i in range(20)])
+    sentinel = LeakSentinel(window_s=1e9, min_points=6, noise_floor=1.0)
+    # not proc.* / ring.*: the default sweep ignores it
+    assert sentinel.check(store) == []
+    assert [s.gauge for s in
+            sentinel.check(store, gauges=["worker.forward_queue_depth"])
+            ] == ["worker.forward_queue_depth"]
+
+
+def test_thread_probe_tracks_a_real_thread():
+    stop = threading.Event()
+    base = SENTINELS.sample()["proc.threads"]
+    t = threading.Thread(target=stop.wait, daemon=True)
+    t.start()
+    try:
+        assert SENTINELS.sample()["proc.threads"] >= base + 1
+    finally:
+        stop.set()
+        t.join()

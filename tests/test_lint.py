@@ -68,18 +68,21 @@ def test_context_parsed_from_real_declarations():
     assert "worker.solve_s" in CTX.histograms
     assert "rpc.client.call_s." in CTX.histogram_prefixes
     assert "rpc.server.dispatch_s." in CTX.histogram_prefixes
+    assert "proc.rss_bytes" in CTX.gauges
+    assert "ring.repl_queue_depth" in CTX.gauges
+    assert CTX.gauge_prefixes == ()
     assert {"Backend", "CacheFile", "MineRetries",
             "TelemetryDir"} <= CTX.config_fields
 
 
 def test_known_series_documented():
-    """Every declared counter and histogram appears in the metrics.py
-    docstring — the human registry and the machine registry must not
-    drift."""
+    """Every declared counter, histogram, and gauge appears in the
+    metrics.py docstring — the human registry and the machine registry
+    must not drift."""
     import distpow_tpu.runtime.metrics as m
 
     doc = m.__doc__ or ""
-    for declared in (m.KNOWN_COUNTERS, m.KNOWN_HISTOGRAMS):
+    for declared in (m.KNOWN_COUNTERS, m.KNOWN_HISTOGRAMS, m.KNOWN_GAUGES):
         missing = sorted(
             c for c in declared
             if c not in doc and f"``.{c.split('.', 1)[1]}" not in doc
@@ -96,7 +99,7 @@ CASES = [
     ("trace-vocabulary", "trace_vocabulary_bad.py",
      "trace_vocabulary_ok.py", 3),
     ("metrics-registry", "metrics_registry_bad.py",
-     "metrics_registry_ok.py", 5),
+     "metrics_registry_ok.py", 7),
     ("config-key-sync", "config_key_sync_bad.py",
      "config_key_sync_ok.py", 3),
     ("hot-path-host-sync", os.path.join("ops", "hot_path_host_sync_bad.py"),
@@ -164,6 +167,13 @@ CASES = [
     ("transitive-blocking-under-lock",
      os.path.join("concurrency", "transitive_blocking_bad.py"),
      os.path.join("concurrency", "transitive_blocking_ok.py"), 2),
+    # long-haul soak plane (ISSUE 18): a wall-clock delta in a duration
+    # position silently corrupts every latency/lag series under NTP
+    # slew; the ok fixture blesses the wall-stamp/monotonic-delta
+    # idiom and the justified cross-process-staleness suppression
+    ("wall-clock-duration",
+     os.path.join("runtime", "wall_clock_duration_bad.py"),
+     os.path.join("runtime", "wall_clock_duration_ok.py"), 4),
 ]
 
 

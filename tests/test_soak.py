@@ -1,0 +1,186 @@
+"""Soak plane end to end (distpow_tpu/load/shapes.py + soak.py,
+ISSUE 18): seeded shape schedules are deterministic, compression
+preserves expected arrivals per phase, Sum names composite phases, and
+run_soak turns a real in-process cluster into a typed SoakVerdict —
+green on a clean run, nonzero naming proc.threads under a planted
+thread-per-request leak."""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from distpow_tpu.load import (  # noqa: E402
+    InProcCluster,
+    LoadMix,
+    run_soak,
+)
+from distpow_tpu.load.shapes import (  # noqa: E402
+    Compressed,
+    Constant,
+    Diurnal,
+    FlashCrowd,
+    Ramp,
+    Sum,
+    build_shaped_schedule,
+    compress,
+)
+from distpow_tpu.runtime.metrics import REGISTRY as metrics  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SLO_CONFIG = os.path.join(REPO, "config", "slo.json")
+
+
+def mk_mix(seed, **kw):
+    kw.setdefault("n_keys", 24)
+    kw.setdefault("zipf_s", 1.1)
+    kw.setdefault("difficulties", ((1, 0.7), (2, 0.3)))
+    return LoadMix(rate_hz=1.0, duration_s=1.0, seed=seed, **kw)
+
+
+# -- shape algebra -----------------------------------------------------------
+
+def test_shaped_schedule_is_deterministic_per_seed():
+    shape = Sum(parts=(
+        Diurnal(base=6.0, amplitude=4.0, period_s=40.0),
+        FlashCrowd(extra_hz=10.0, at_s=22.0, width_s=4.0, duration_s=40.0),
+    ))
+    a = build_shaped_schedule(shape, mk_mix(7))
+    b = build_shaped_schedule(shape, mk_mix(7))
+    assert a and a == b
+    c = build_shaped_schedule(shape, mk_mix(8))
+    assert c != a
+
+
+def test_thinning_respects_the_shape_support():
+    crowd = FlashCrowd(extra_hz=30.0, at_s=10.0, width_s=5.0,
+                       duration_s=30.0)
+    sched = build_shaped_schedule(crowd, mk_mix(11))
+    assert sched
+    assert all(10.0 <= arr.t < 15.0 for arr in sched)
+    assert build_shaped_schedule(Constant(0.0, 10.0), mk_mix(11)) == []
+
+
+def test_compression_preserves_expected_arrival_count():
+    """compress(shape, f) scales time down and rate up by f, so the
+    expected arrivals stay put — the 4-sigma Poisson band pins it
+    (seeded: deterministic, no flake)."""
+    inner = Diurnal(base=5.0, amplitude=3.0, period_s=200.0)
+    expected = 5.0 * 200.0  # the sine integrates to zero over a period
+    squeezed = compress(inner, 100.0)
+    assert squeezed.duration_s == pytest.approx(2.0)
+    assert squeezed.peak_hz() == pytest.approx(inner.peak_hz() * 100.0)
+    band = 4.0 * math.sqrt(expected)
+    for shape, seed in ((inner, 3), (squeezed, 3), (squeezed, 4)):
+        n = len(build_shaped_schedule(shape, mk_mix(seed)))
+        assert abs(n - expected) < band, (shape, n)
+
+
+def test_compressed_phases_scale_with_names_intact():
+    inner = Diurnal(base=5.0, amplitude=3.0, period_s=200.0)
+    squeezed = compress(inner, 100.0)
+    assert [(n, s, e) for n, s, e in squeezed.phases()] == [
+        (n, s / 100.0, e / 100.0) for n, s, e in inner.phases()]
+    with pytest.raises(ValueError):
+        Compressed(inner=inner, factor=0.0)
+
+
+def test_sum_phases_union_boundaries_and_composite_names():
+    shape = Sum(parts=(
+        Diurnal(base=6.0, amplitude=4.0, period_s=40.0),
+        FlashCrowd(extra_hz=10.0, at_s=22.0, width_s=4.0, duration_s=40.0),
+    ))
+    phases = shape.phases()
+    assert [p[0] for p in phases] == [
+        "rise+before", "peak+before", "fall+before", "fall+spike",
+        "fall+after", "trough+after"]
+    # contiguous cover of the whole duration
+    assert phases[0][1] == 0.0 and phases[-1][2] == 40.0
+    assert all(a[2] == b[1] for a, b in zip(phases, phases[1:]))
+    # rates superpose pointwise
+    assert shape.rate_hz(23.0) == pytest.approx(
+        shape.parts[0].rate_hz(23.0) + 10.0)
+
+
+def test_ramp_and_diurnal_rate_envelopes():
+    ramp = Ramp(start_hz=2.0, end_hz=10.0, duration_s=10.0)
+    assert ramp.rate_hz(0.0) == pytest.approx(2.0)
+    assert ramp.rate_hz(5.0) == pytest.approx(6.0)
+    assert ramp.rate_hz(10.0) == 0.0  # past the end
+    assert ramp.peak_hz() == 10.0
+    day = Diurnal(base=3.0, amplitude=5.0, period_s=40.0)
+    assert day.peak_hz() == pytest.approx(8.0)
+    assert day.rate_hz(30.0) == 0.0  # trough clamps at zero
+    assert min(day.rate_hz(t / 4.0) for t in range(160)) >= 0.0
+
+
+def test_multi_day_diurnal_phase_names_number_the_days():
+    two_days = Diurnal(base=3.0, amplitude=1.0, period_s=20.0,
+                       duration_s=40.0)
+    assert [p[0] for p in two_days.phases()] == [
+        "day1.rise", "day1.peak", "day1.fall", "day1.trough",
+        "day2.rise", "day2.peak", "day2.fall", "day2.trough"]
+
+
+# -- run_soak end to end -----------------------------------------------------
+
+def test_green_soak_ends_in_a_passing_verdict(tmp_path):
+    spool = str(tmp_path / "spool.jsonl")
+    report, verdict = run_soak(
+        Constant(8.0, 5.0), mk_mix(1811), SLO_CONFIG,
+        n_workers=2, scrape_interval_s=0.3, spool_path=spool,
+    )
+    assert verdict.exit_code() == 0 and verdict.status == "pass"
+    assert not verdict.failures and not verdict.leak_suspects
+    assert verdict.phases and all(
+        p.status in ("pass", "warn", "no_data") for p in verdict.phases)
+    assert verdict.lag_p99_s is not None
+    assert verdict.lag_p99_s <= verdict.lag_budget_s
+    assert report["load"]["issued"] > 20
+    assert os.path.exists(spool)
+    # the verdict renders for humans and serializes for machines
+    assert "Soak verdict: PASS" in verdict.render()
+    assert verdict.to_dict()["status"] == "pass"
+
+
+@pytest.mark.slow
+def test_planted_thread_leak_flips_the_verdict_nonzero():
+    """The classic slow-executor leak — one parked daemon thread per
+    request — must climb proc.threads past the sentinel's noise floor
+    and fail the soak BY NAME."""
+    cluster = InProcCluster(n_workers=2)
+    stop = threading.Event()
+    parked = []
+    real_mine = cluster.client.mine
+
+    def leaky_mine(*a, **kw):
+        t = threading.Thread(target=stop.wait, daemon=True)
+        t.start()
+        parked.append(t)
+        return real_mine(*a, **kw)
+
+    cluster.client.mine = leaky_mine
+    before = metrics.snapshot()["counters"].get("health.leak_suspects", 0)
+    try:
+        report, verdict = run_soak(
+            Constant(8.0, 6.0), mk_mix(1812), SLO_CONFIG,
+            cluster=cluster, scrape_interval_s=0.25,
+        )
+    finally:
+        stop.set()
+        time.sleep(0.05)
+        cluster.close()
+    assert len(parked) > 20
+    assert verdict.exit_code() == 1 and verdict.status == "breach"
+    named = [s["gauge"] for s in verdict.leak_suspects]
+    assert "proc.threads" in named
+    assert any("proc.threads" in f for f in verdict.failures)
+    after = metrics.snapshot()["counters"].get("health.leak_suspects", 0)
+    assert after >= before + 1

@@ -1,0 +1,236 @@
+"""Tiered time-series retention (distpow_tpu/obs/timeseries.py,
+ISSUE 18): last-point-per-interval downsampling vs a full-resolution
+oracle (bit-identical at retained boundaries, within one log-grid
+bucket otherwise), tier eviction, windowed delta/rate queries, gauge
+trajectories, and the rotated-JSONL spool round-trip."""
+
+from __future__ import annotations
+
+import math
+import random
+
+import pytest
+
+from distpow_tpu.obs.merge import BUCKET_RATIO
+from distpow_tpu.obs.timeseries import (
+    DEFAULT_TIERS,
+    TimeSeriesStore,
+    Tier,
+    replay_spool,
+)
+from distpow_tpu.runtime.metrics import Histogram
+
+T0 = 1_000_000.0  # divisible by every tier resolution used below
+
+
+def snap(ts, hist=None, counters=None, gauges=None, per_node=None):
+    """A minimal merged cluster snapshot (obs/merge.py shape)."""
+    return {
+        "ts": ts,
+        "nodes": 1,
+        "counters": dict(counters or {}),
+        "gauges": dict(gauges or {}),
+        "histograms": {"worker.solve_s": hist} if hist else {},
+        "per_node": dict(per_node or {}),
+        "per_model": {},
+        "stale_nodes": [],
+    }
+
+
+# -- tier mechanics ----------------------------------------------------------
+
+def test_finest_tier_keeps_all_coarse_keeps_last_per_interval():
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9), Tier(10.0, 1e9)))
+    for i in range(25):
+        store.append(snap(T0 + i, counters={"x": i}))
+    assert [t - T0 for t, _ in store.tier_points(0)] == list(range(25))
+    # 10 s tier: the LAST cumulative snapshot of each interval wins
+    assert [t - T0 for t, _ in store.tier_points(1)] == [9.0, 19.0, 24.0]
+    assert store.tier_points(1)[0][1]["counters"]["x"] == 9
+
+
+def test_retention_evicts_points_older_than_the_tier_window():
+    store = TimeSeriesStore(tiers=(Tier(0.0, 30.0),))
+    for i in range(61):
+        store.append(snap(T0 + i))
+    pts = store.tier_points(0)
+    assert pts[0][0] >= T0 + 30.0 and pts[-1][0] == T0 + 60.0
+
+
+def test_len_counts_distinct_points_across_tiers():
+    store = TimeSeriesStore(tiers=DEFAULT_TIERS)
+    for i in range(12):
+        store.append(snap(T0 + i))
+    # every point is in the finest tier; coarser tiers hold subsets
+    assert len(store) == 12
+
+
+def test_append_defaults_to_the_snapshot_own_ts():
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9),))
+    store.append(snap(T0 + 5.5))
+    assert store.latest()[0] == T0 + 5.5
+
+
+def test_snapshot_at_resolves_finest_tier_first():
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9), Tier(10.0, 1e9)))
+    for i in range(25):
+        store.append(snap(T0 + i, counters={"x": i}))
+    t, m = store.snapshot_at(T0 + 17.4)
+    assert t == T0 + 17.0 and m["counters"]["x"] == 17
+
+
+# -- downsampling vs the full-resolution oracle ------------------------------
+
+def _cumulative_stores(n_seconds, seed, per_step=20):
+    """One-per-second cumulative snapshots of one latency stream, fed
+    to a full-resolution store and a 10 s-downsampled store."""
+    rng = random.Random(seed)
+    full = TimeSeriesStore(tiers=(Tier(0.0, 1e9),))
+    coarse = TimeSeriesStore(tiers=(Tier(10.0, 1e9),))
+    h = Histogram()
+    for i in range(n_seconds + 1):
+        for _ in range(per_step):
+            h.observe(rng.lognormvariate(-3.0, 0.6))
+        m = snap(T0 + i, hist=h.to_dict(),
+                 counters={"coord.requests": (i + 1) * per_step})
+        full.append(m)
+        coarse.append(m)
+    return full, coarse
+
+
+def test_range_window_bit_identical_at_retained_boundaries():
+    """Tier math (timeseries.py docstring): deltas between two RETAINED
+    snapshots are exact, so when the query boundaries land on points the
+    coarse tier kept, the downsampled answer EQUALS the oracle."""
+    full, coarse = _cumulative_stores(240, seed=1807)
+    wf = full.range_window(T0 + 19.0, T0 + 239.0)
+    wc = coarse.range_window(T0 + 19.0, T0 + 239.0)
+    assert wf == wc
+
+
+@pytest.mark.parametrize("start_s,end_s", [
+    (30.5, 235.0),
+    (47.3, 180.2),
+    (0.0, 240.0),
+    (75.9, 120.1),
+])
+def test_downsampled_percentile_within_one_bucket_of_oracle(start_s, end_s):
+    """Off-boundary queries shift the window edge up to one resolution
+    step earlier; the percentile estimate must stay within one log-grid
+    bucket (~19%) of the full-resolution oracle — the same bound the
+    PR 7 merge pins."""
+    full, coarse = _cumulative_stores(240, seed=1808)
+    wf = full.range_window(T0 + start_s, T0 + end_s)
+    wc = coarse.range_window(T0 + start_s, T0 + end_s)
+    for q in ("p50", "p95", "p99"):
+        pf = wf["histograms"]["worker.solve_s"][q]
+        pc = wc["histograms"]["worker.solve_s"][q]
+        assert pf is not None and pc is not None
+        assert max(pf, pc) / min(pf, pc) <= BUCKET_RATIO * (1 + 1e-9), (
+            f"{q}: full {pf} vs downsampled {pc} drifted more than "
+            f"one bucket")
+
+
+def test_downsampled_counter_delta_bounded_by_boundary_shift():
+    """Counters grow 20/s here, so a window widened by at most one 10 s
+    resolution step can over-count by at most 200."""
+    full, coarse = _cumulative_stores(240, seed=1809)
+    wf = full.range_window(T0 + 47.3, T0 + 180.2)
+    wc = coarse.range_window(T0 + 47.3, T0 + 180.2)
+    df = wf["counters"]["coord.requests"]
+    dc = wc["counters"]["coord.requests"]
+    assert abs(dc - df) <= 200
+
+
+# -- windowed queries --------------------------------------------------------
+
+def test_window_degrades_to_cumulative_then_oldest():
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9),))
+    assert store.window(60.0) is None
+    store.append(snap(T0, counters={"x": 10}))
+    # one point: the latest snapshot stands as-is (cumulative)
+    assert store.window(60.0)["counters"]["x"] == 10
+    store.append(snap(T0 + 5.0, counters={"x": 30}))
+    # history shallower than the window: the oldest point stands in
+    win = store.window(60.0)
+    assert win["counters"]["x"] == 20 and win["window_s"] == 5.0
+
+
+def test_range_window_none_without_a_point_before_end():
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9),))
+    store.append(snap(T0 + 50.0))
+    assert store.range_window(T0, T0 + 10.0) is None
+
+
+def test_counter_rate_over_window():
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9),))
+    store.append(snap(T0, counters={"coord.requests": 0}))
+    store.append(snap(T0 + 10.0, counters={"coord.requests": 50}))
+    assert store.counter_rate("coord.requests", 10.0) == pytest.approx(5.0)
+    assert store.counter_rate("coord.nope", 10.0) == 0.0
+
+
+def test_gauge_series_fleet_per_node_and_window():
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9), Tier(10.0, 1e9)))
+    for i in range(30):
+        store.append(snap(
+            T0 + i, gauges={"proc.threads": 10.0 + i},
+            per_node={"w0": {"gauges": {"proc.threads": 4.0 + i}}}))
+    series = store.gauge_series("proc.threads")
+    # deduped across tiers: one entry per distinct timestamp
+    assert len(series) == 30
+    assert series[0] == (T0, 10.0) and series[-1] == (T0 + 29, 39.0)
+    node = store.gauge_series("proc.threads", node="w0")
+    assert node[-1] == (T0 + 29, 33.0)
+    recent = store.gauge_series("proc.threads", window_s=5.0)
+    assert [t - T0 for t, _ in recent] == [24.0, 25, 26, 27, 28, 29]
+    assert store.gauge_series("proc.absent") == []
+    assert "proc.threads" in store.gauge_names()
+
+
+# -- JSONL spool -------------------------------------------------------------
+
+def test_spool_rotates_and_replays_oldest_first(tmp_path):
+    path = str(tmp_path / "spool.jsonl")
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9),), spool_path=path,
+                            spool_max_bytes=2048, spool_keep=8)
+    for i in range(20):
+        store.append(snap(T0 + i, counters={"x": i},
+                          gauges={"proc.threads": float(i)}))
+    assert (tmp_path / "spool.jsonl.1").exists()  # size cap forced rotation
+    replayed = list(replay_spool(path))
+    assert [t - T0 for t, _ in replayed] == list(range(20))
+
+    rebuilt = TimeSeriesStore(tiers=(Tier(0.0, 1e9),))
+    for ts, merged in replayed:
+        rebuilt.append(merged, ts)
+    assert rebuilt.latest() == store.latest()
+    assert rebuilt.window(10.0) == store.window(10.0)
+    assert rebuilt.gauge_series("proc.threads") == \
+        store.gauge_series("proc.threads")
+
+
+def test_replay_skips_corrupt_lines(tmp_path):
+    path = str(tmp_path / "spool.jsonl")
+    store = TimeSeriesStore(tiers=(Tier(0.0, 1e9),), spool_path=path)
+    store.append(snap(T0))
+    with open(path, "a") as fh:
+        fh.write("not json\n")
+        fh.write('{"ts": "oops", "merged": {}}\n')
+    store.append(snap(T0 + 1))
+    assert [t - T0 for t, _ in replay_spool(path)] == [0.0, 1.0]
+
+
+# -- construction guards -----------------------------------------------------
+
+def test_bad_tier_configs_rejected():
+    with pytest.raises(ValueError):
+        TimeSeriesStore(tiers=())
+    with pytest.raises(ValueError):
+        TimeSeriesStore(tiers=(Tier(10.0, 0.0),))
+
+
+def test_default_tiers_are_sorted_and_sane():
+    assert [t.resolution_s for t in DEFAULT_TIERS] == [0.0, 10.0, 60.0]
+    assert all(t.retention_s > 0 for t in DEFAULT_TIERS)
+    assert math.isfinite(BUCKET_RATIO) and BUCKET_RATIO > 1.0
