@@ -214,7 +214,23 @@ class JaxBackend:
     def search(self, nonce, difficulty, thread_bytes, cancel_check=None):
         from ..parallel.search import persistent_search, search
 
-        drive = persistent_search if self.loop == "persistent" else search
+        kwargs = {}
+        if self.loop == "persistent":
+            drive = persistent_search
+            # launch-lane planning (sched/lanes.py): on a multi-device
+            # host the persistent dispatches serve through the mesh
+            # persistent step — byte-identical results, n_dev x the
+            # per-dispatch coverage.  Single device resolves to None
+            # and this stays the classic single-device loop.
+            from ..parallel.partition import contiguous_bounds
+            from ..sched.lanes import persistent_step_builder
+
+            tb_lo, tbc = contiguous_bounds(thread_bytes)
+            kwargs["step_builder"] = persistent_step_builder(
+                bytes(nonce), difficulty, tb_lo, tbc, self.model
+            )
+        else:
+            drive = search
         res = drive(
             nonce,
             difficulty,
@@ -223,6 +239,7 @@ class JaxBackend:
             batch_size=self.batch_size,
             cancel_check=cancel_check,
             launch_candidates=self.max_launch,
+            **kwargs,
         )
         return None if res is None else res.secret
 

@@ -653,6 +653,7 @@ class Worker:
                 fallback=backend,
                 extra_models=tuple(
                     getattr(config, "SchedHashModels", ()) or ()),
+                lane=getattr(config, "SchedLane", "auto") or "auto",
             )
         self.handler = WorkerRPCHandler(
             self.tracer, self.result_queue, backend,
@@ -741,8 +742,13 @@ class Worker:
 
         mhs = float(getattr(self.config, "FleetMHS", 0.0) or 0.0)
         if mhs <= 0:
+            # calibrate through the SERVING path: with the batching
+            # scheduler on, requests run through its lane planner
+            # (sched/lanes.py — mesh/pallas launch lanes), so the
+            # advertised rate must be measured through the same facade
+            # or a multi-device worker under-advertises by n_dev x
             mhs = calibrate_mhs(
-                self._backend,
+                self.scheduler or self._backend,
                 budget_s=float(
                     getattr(self.config, "FleetCalibrationS", 0.2) or 0.0),
             )

@@ -1,0 +1,125 @@
+"""Version-portable mesh/sharding API surface (docs/SERVING.md).
+
+JAX has moved the mesh-programming primitives twice in the versions
+this repo has run against: ``shard_map`` graduated from
+``jax.experimental.shard_map`` (replication checking spelled
+``check_rep``) to ``jax.shard_map`` (spelled ``check_vma``), the
+varying-axes cast has been ``jax.lax.pcast``, ``jax.lax.pvary`` or
+nothing at all, and the virtual-CPU-device knob is the
+``jax_num_cpu_devices`` config option on new versions but only the
+``--xla_force_host_platform_device_count`` XLA flag on older ones.
+
+Every mesh call site in the repo (parallel/mesh_search.py, the
+backends registry, the lane planner, the mesh/env tests) imports this
+module instead of touching the moving target directly, so a JAX
+upgrade is a one-file change here rather than a failure class across
+the tree.
+
+``shard_map`` / ``pvary`` resolve the available spelling at import
+time; ``request_cpu_devices`` / ``cpu_devices_env`` cover the two
+virtual-device mechanisms (in-process config vs pre-init env flag).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: F401
+
+# new-style promoted API (jax.shard_map, check_vma) when present; the
+# deprecation-module __getattr__ raises AttributeError on versions
+# without it, which getattr maps to None
+_NEW_SHARD_MAP = getattr(jax, "shard_map", None)
+try:
+    from jax.experimental.shard_map import shard_map as _EXP_SHARD_MAP
+except ImportError:  # pragma: no cover - no known version lacks both
+    _EXP_SHARD_MAP = None
+
+#: True when SOME shard_map spelling exists — the version-gated skip
+#: condition for the mesh tests (no known supported version lacks both).
+HAS_SHARD_MAP = _NEW_SHARD_MAP is not None or _EXP_SHARD_MAP is not None
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: Optional[bool] = None):
+    """``shard_map`` under whichever spelling this JAX provides.
+
+    ``check_vma`` is the new-style name for replication/varying-axes
+    type checking; on versions that predate it the value is passed as
+    ``check_rep`` (the same semantics under the older name).  ``None``
+    keeps each version's default.
+    """
+    kwargs = {}
+    if _NEW_SHARD_MAP is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _NEW_SHARD_MAP(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    if _EXP_SHARD_MAP is None:  # pragma: no cover - see HAS_SHARD_MAP
+        raise NotImplementedError(
+            "this JAX version provides neither jax.shard_map nor "
+            "jax.experimental.shard_map"
+        )
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _EXP_SHARD_MAP(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def pvary(x, axis: str):
+    """Mark a replicated value as varying over ``axis`` (shard_map's
+    varying-manual-axes typing); name differs across JAX versions and
+    the oldest ones need no cast at all."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, (axis,), to="varying")
+    fn = getattr(jax.lax, "pvary", None)
+    if fn is not None:
+        return fn(x, (axis,))
+    return x
+
+
+_HOST_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def request_cpu_devices(n: int) -> bool:
+    """Ask for ``n`` virtual CPU devices, portably.
+
+    New JAX versions take the ``jax_num_cpu_devices`` config option (and
+    raise RuntimeError if the CPU backend is already initialized — the
+    caller's clear_backends discipline, see ``__graft_entry__``).  Older
+    versions only read the ``--xla_force_host_platform_device_count``
+    XLA flag, which the backend consumes at its NEXT initialization — so
+    on those this must run before the first device touch (or after a
+    ``clear_backends``).  Returns True when the config option took
+    effect in-process, False when only the pre-init env flag was set.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+        return True
+    except (AttributeError, ValueError):
+        # AttributeError: option does not exist on this version;
+        # ValueError: some versions reject unknown options this way
+        pass
+    flags = re.sub(rf"{_HOST_COUNT_FLAG}=\S+", "",
+                   os.environ.get("XLA_FLAGS", "")).strip()
+    os.environ["XLA_FLAGS"] = f"{flags} {_HOST_COUNT_FLAG}={int(n)}".strip()
+    return False
+
+
+def cpu_devices_env(n: int,
+                    base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Environment for a SUBPROCESS that must boot with ``n`` virtual
+    CPU devices: the pre-init env-flag mechanism works on every JAX
+    version, so child arms (bench.py --mesh-serving, scripts/mesh_smoke)
+    use it regardless of what the parent process supports."""
+    env = dict(os.environ if base is None else base)
+    flags = re.sub(rf"{_HOST_COUNT_FLAG}=\S+", "",
+                   env.get("XLA_FLAGS", "")).strip()
+    env["XLA_FLAGS"] = f"{flags} {_HOST_COUNT_FLAG}={int(n)}".strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    return env

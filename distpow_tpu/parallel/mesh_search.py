@@ -48,6 +48,8 @@ from ..ops.search_step import (
     mask_words_for,
     step_operands,
 )
+from .compat import pvary as _pvary
+from .compat import shard_map as _shard_map
 from .partition import contiguous_bounds
 from .search import SearchResult, StepFactory, search
 
@@ -56,20 +58,11 @@ AXIS = "workers"
 log = logging.getLogger("distpow.mesh_search")
 
 
-def _pvary(x, axis: str):
-    """Mark a replicated value as varying over ``axis`` (shard_map's
-    varying-manual-axes typing); name differs across JAX versions."""
-    fn = getattr(jax.lax, "pcast", None)
-    if fn is not None:
-        return fn(x, (axis,), to="varying")
-    fn = getattr(jax.lax, "pvary", None)
-    if fn is not None:
-        return fn(x, (axis,))
-    return x
-
-
 def make_mesh(devices: Optional[Sequence] = None, axis: str = AXIS) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
+    from ..runtime.metrics import REGISTRY as metrics
+
+    metrics.gauge("search.mesh_devices", len(devs))
     return Mesh(np.array(devs), (axis,))
 
 
@@ -150,7 +143,7 @@ def _dyn_mesh_step(
             )
         return jax.lax.pmin(m, axis)
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         body, mesh=mesh, in_specs=(P(), P(), P(), P(), P()), out_specs=P()
     )
     return jax.jit(sharded)
@@ -251,7 +244,7 @@ def _dyn_pallas_mesh_step(
     # annotation, so shard_map's per-value VMA typing cannot see that the
     # kernel output is device-varying; the explicit pmin below is the
     # collective that makes the result replicated regardless.
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         body, mesh=mesh, in_specs=(P(), P(), P(), P(), P()), out_specs=P(),
         check_vma=False,
     )
@@ -455,7 +448,7 @@ def _mesh_step_factory(
                 m = jnp.min(jnp.where(hit, f_global, jnp.uint32(SENTINEL)))
                 return jax.lax.pmin(m, axis)
 
-        sharded = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P())
+        sharded = _shard_map(body, mesh=mesh, in_specs=P(), out_specs=P())
         return jax.jit(sharded)
 
     def factory(vw: int, extra: bytes, target_chunks: int, launch_steps: int = 1):
@@ -478,6 +471,232 @@ def _mesh_step_factory(
         return step, global_chunks
 
     return factory
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_slot_search_step(
+    mesh: Mesh,
+    axis: str,
+    model_name: str,
+    n_blocks: int,
+    tb_loc,
+    chunk_locs,
+    batch_local: int,
+    n_slots: int,
+):
+    """Multi-slot serving step spread over the device mesh — the
+    scheduler's ``mesh`` launch lane (sched/lanes.py, docs/SERVING.md).
+
+    Same signature and contract as ``ops.search_step.slot_search_step``
+    with ``batch = batch_local * n_dev``: ``(init[n, S],
+    base[n, n_blocks, W], masks[n, D], tb_lo[n], log_tbc[n],
+    chunk0[n]) -> uint32[n]``.  Device ``d`` evaluates the contiguous
+    flat sub-range ``[d * batch_local, (d+1) * batch_local)`` of every
+    slot's lane and ``lax.pmin`` folds the per-device minima, so the
+    returned per-slot first-hit index is byte-identical to the
+    single-device step over the same global span — one launch simply
+    covers ``n_dev`` x the candidates (the lane-parity suite,
+    tests/test_lanes.py, pins this).
+    """
+    model = get_hash_model(model_name)
+    n_dev = int(mesh.devices.size)
+    one = jnp.uint32(1)
+    _check_launch(batch_local * n_dev, 1)
+
+    def body(init, base, masks, tb_lo, log_tbc, chunk0):
+        d = jax.lax.axis_index(axis).astype(jnp.uint32)
+        f0 = d * jnp.uint32(batch_local) + jnp.arange(
+            batch_local, dtype=jnp.uint32
+        )
+
+        def lane(init1, base1, masks1, tb_lo1, log_tbc1, chunk01):
+            chunk = chunk01 + (f0 >> log_tbc1)
+            tb = tb_lo1 + (f0 & ((one << log_tbc1) - one))
+            state = eval_dyn_candidates(
+                model, n_blocks, tb_loc, chunk_locs, init1, base1, tb, chunk
+            )
+            hit = fold_dyn_masks(model, state, masks1)
+            return jnp.min(jnp.where(hit, f0, jnp.uint32(SENTINEL)))
+
+        local = jax.vmap(lane)(init, base, masks, tb_lo, log_tbc, chunk0)
+        return jax.lax.pmin(local, axis)
+
+    # check_vma=False for the same reason as the pallas mesh step: the
+    # explicit pmin is the collective that makes the result replicated;
+    # the vmapped lane's varying-axes typing differs across JAX versions
+    sharded = _shard_map(
+        body, mesh=mesh, in_specs=(P(),) * 6, out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_persistent_step(
+    mesh: Mesh,
+    axis: str,
+    model_name: str,
+    n_blocks: int,
+    tb_loc,
+    chunk_locs,
+    batch_local: int,
+    static_tbc,  # None => power-of-two partition passed as log2 operand
+    segments: int,
+    mask_words: int = 0,
+):
+    """Persistent-loop serving step spread over the device mesh — the
+    solo/persistent route's ``mesh`` lane (docs/SERVING.md).
+
+    Mirrors ``ops.search_step.persistent_search_step`` with
+    ``batch = batch_local * n_dev``: the same multi-segment on-device
+    ``while_loop`` with early exit on hit or host stop flag, but each
+    segment's candidate sub-batch is split across the mesh (device ``d``
+    owns flat ``[d * batch_local, (d+1) * batch_local)`` within the
+    segment) and a per-segment ``lax.pmin`` folds the device minima into
+    the replicated carry — every device therefore observes a hit at the
+    same segment boundary and exits together, the module-docstring
+    "first result wins, everyone stops" protocol applied inside one
+    dispatch.  Returned fn signature matches the single-device step:
+    ``(init, base, masks, tb_lo, [log_tbc,] chunk0, stop) -> uint32[2]``
+    (first-hit global flat index + segments executed).
+    """
+    model = get_hash_model(model_name)
+    n_dev = int(mesh.devices.size)
+    batch_global = batch_local * n_dev
+    _check_launch(batch_global, segments)
+    one = jnp.uint32(1)
+    mw = mask_words or model.digest_words
+
+    def make_step(take_log_tbc: bool):
+        def step(init, base, masks, tb_lo, log_tbc, chunk0, stop):
+            d = jax.lax.axis_index(axis).astype(jnp.uint32)
+            f0 = d * jnp.uint32(batch_local) + jnp.arange(
+                batch_local, dtype=jnp.uint32
+            )
+
+            def cond(state):
+                seg, best = state
+                return (
+                    (seg < jnp.uint32(segments))
+                    & (best == jnp.uint32(SENTINEL))
+                    & (stop == jnp.uint32(0))
+                )
+
+            def seg_body(state):
+                seg, best = state
+                f = seg * jnp.uint32(batch_global) + f0
+                if static_tbc is None:
+                    chunk = jnp.uint32(chunk0) + (f >> log_tbc)
+                    tb = tb_lo + (f & ((one << log_tbc) - one))
+                else:
+                    chunk = jnp.uint32(chunk0) + f // jnp.uint32(static_tbc)
+                    tb = tb_lo + f % jnp.uint32(static_tbc)
+                state_w = eval_dyn_candidates(
+                    model, n_blocks, tb_loc, chunk_locs, init, base, tb,
+                    chunk,
+                )
+                hit = fold_dyn_masks(model, state_w, masks, mw)
+                found = jnp.min(jnp.where(hit, f, jnp.uint32(SENTINEL)))
+                found = jax.lax.pmin(found, axis)
+                return seg + one, jnp.minimum(best, found)
+
+            seg, best = jax.lax.while_loop(
+                cond, seg_body, (jnp.uint32(0), jnp.uint32(SENTINEL))
+            )
+            return jnp.stack([best, seg])
+
+        if take_log_tbc:
+            return step
+
+        def step_static(init, base, masks, tb_lo, chunk0, stop):
+            return step(init, base, masks, tb_lo, jnp.uint32(0), chunk0,
+                        stop)
+
+        return step_static
+
+    n_in = 7 if static_tbc is None else 6
+    # check_vma=False: the per-segment pmin inside the while_loop body is
+    # what makes the carry replicated; the VMA/replication typing of a
+    # collective inside a loop carry differs across JAX versions
+    sharded = _shard_map(
+        make_step(static_tbc is None), mesh=mesh, in_specs=(P(),) * n_in,
+        out_specs=P(), check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def mesh_persistent_factory(
+    nonce: bytes,
+    difficulty: int,
+    tb_lo: int,
+    tbc: int,
+    model: HashModel,
+    mesh: Mesh,
+    axis: str = AXIS,
+):
+    """Persistent step builder over the mesh — the ``step_builder`` hook
+    of ``parallel.search.persistent_search`` (the solo/persistent
+    route's mesh lane).
+
+    Returns ``builder(vw, extra, target_chunks, segments) ->
+    (bound(chunk0, stop), chunks_each, chunks_per_step)`` with the
+    driver's exact accounting contract: each dispatch covers up to
+    ``segments`` on-device segments of ``target_chunks`` GLOBAL chunks
+    each.  Raises ValueError (from the builder) when the global segment
+    batch does not divide across the mesh — the caller falls back to
+    the single-device persistent step, same per-width contract as the
+    pallas mesh factory.
+
+    Bound operands are pre-placed replicated on the mesh at bind time
+    (``jax.device_put`` with a replicated ``NamedSharding``), so steady-
+    state dispatches move only the chunk cursor and stop flag.
+    """
+    from .compat import NamedSharding
+
+    n_dev = int(mesh.devices.size)
+    repl = NamedSharding(mesh, P())
+    pow2 = tbc & (tbc - 1) == 0
+
+    @functools.lru_cache(maxsize=32)
+    def builder(vw: int, extra: bytes, target_chunks: int, segments: int):
+        if vw == 0:
+            raise ValueError(
+                "width 0 has no persistent form; use cached_search_step"
+            )
+        batch_global = target_chunks * tbc
+        if batch_global % n_dev:
+            raise ValueError(
+                f"segment batch {batch_global} (chunks={target_chunks}, "
+                f"tbc={tbc}) does not divide across {n_dev} devices"
+            )
+        spec = build_tail_spec(bytes(nonce), vw, model, extra)
+        mw = mask_words_for(difficulty, model)
+        dyn = mesh_persistent_step(
+            mesh, axis, model.name, spec.n_blocks, spec.tb_loc,
+            spec.chunk_locs, batch_global // n_dev,
+            None if pow2 else tbc, segments, mw,
+        )
+        init, base, masks = step_operands(spec, difficulty, model)
+        init, base, masks = (jax.device_put(init, repl),
+                             jax.device_put(base, repl),
+                             jax.device_put(masks, repl))
+        tb_lo_op = jax.device_put(jnp.uint32(tb_lo), repl)
+        if pow2:
+            log_tbc = jax.device_put(
+                jnp.uint32(tbc.bit_length() - 1), repl)
+
+            def bound(chunk0, stop):
+                return dyn(init, base, masks, tb_lo_op, log_tbc, chunk0,
+                           stop)
+
+        else:
+
+            def bound(chunk0, stop):
+                return dyn(init, base, masks, tb_lo_op, chunk0, stop)
+
+        return bound, target_chunks, target_chunks * segments
+
+    return builder
 
 
 def search_mesh(

@@ -524,6 +524,7 @@ def persistent_search(
     max_width: int = 8,
     launch_candidates: Optional[int] = None,
     poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
+    step_builder: Optional[Callable] = None,
 ) -> Optional[SearchResult]:
     """Persistent-loop twin of :func:`search` — same contract, same
     first-hit enumeration order, byte-identical results (the golden
@@ -548,6 +549,17 @@ def persistent_search(
       bounded at the in-flight window (≤ ``pipeline_depth`` dispatches
       running out their segment budget in the background) without
       shrinking launches.
+
+    ``step_builder`` is the launch-lane hook (sched/lanes.py
+    ``persistent_step_builder``): called per width segment as
+    ``step_builder(vw, extra, target_chunks, k)`` it may return
+    ``(step, chunks_each, chunks_per_step)`` — a drop-in for the
+    default single-device persistent step with the identical
+    ``(chunk0, stop) -> uint32[2]`` contract and first-hit order over
+    the same global candidate span — or None to keep the default for
+    that width.  The mesh lane serves every dispatch across all local
+    devices this way; enumeration order (and so results) stays
+    byte-identical.
     """
     model = model or get_hash_model("md5")
     if launch_candidates is None:
@@ -669,12 +681,17 @@ def persistent_search(
                         step, chunks_per_step, chunks_each = \
                             None, 1, 1
                     else:
-                        step = cached_persistent_step(
-                            nonce, vw, difficulty, tb_lo, tbc,
-                            target_chunks, model.name, extra, k,
-                        )
-                        chunks_each = target_chunks
-                        chunks_per_step = target_chunks * k
+                        plan = (step_builder(vw, extra, target_chunks, k)
+                                if step_builder is not None else None)
+                        if plan is not None:
+                            step, chunks_each, chunks_per_step = plan
+                        else:
+                            step = cached_persistent_step(
+                                nonce, vw, difficulty, tb_lo, tbc,
+                                target_chunks, model.name, extra, k,
+                            )
+                            chunks_each = target_chunks
+                            chunks_per_step = target_chunks * k
                     chunk0 = lo
                     first_launch = True
                     while chunk0 < hi:

@@ -160,13 +160,16 @@ def enable(cache_dir: str = DEFAULT_DIR,
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", min_compile_secs
         )
-        if prev_dir is not None and prev_dir != cache_dir:
-            # jax initializes its cache object lazily at the first
-            # compile and then IGNORES config-dir changes; re-pointing
-            # the dir after any compile (a worker rebooting onto a new
-            # CompilationCacheDir in-process) would silently keep
-            # writing to the old one.  reset_cache() returns it to the
-            # uninitialized state so the next compile binds the new dir.
+        if prev_dir != cache_dir:
+            # jax initializes its cache object lazily at the FIRST
+            # compile attempt and then IGNORES config-dir changes —
+            # including the attempt that found no dir configured at
+            # all (_initialize_cache sets its once-latch before the
+            # empty-path early return).  So a worker enabling a
+            # CompilationCacheDir after the process has compiled
+            # anything — prior config dir set OR None — would silently
+            # get no caching.  reset_cache() returns the latch to the
+            # uninitialized state so the next compile binds this dir.
             try:
                 from jax._src import compilation_cache as _cc
 

@@ -280,6 +280,12 @@ class WorkerConfig:
     # the solo path instead.  Empty = HashModel only (pre-PR-6
     # behavior: any other hash forfeits batching).
     SchedHashModels: List[str] = field(default_factory=list)
+    # Launch-lane override for the batching scheduler (sched/lanes.py):
+    # "auto" ranks by hardware capability (pallas on TPU, mesh on any
+    # multi-device host, xla otherwise); "pallas"/"mesh"/"xla" pins that
+    # lane first (a pinned lane that fails to compile still demotes to
+    # xla — the override is a ranking, not a correctness gate).
+    SchedLane: str = "auto"
     # --- elastic fleet (distpow_tpu/fleet/, docs/FLEET.md) ---------------
     # Join the coordinator's fleet via Fleet.Register instead of (not in
     # addition to) being a static entry in the coordinator's Workers

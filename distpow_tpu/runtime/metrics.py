@@ -43,6 +43,9 @@ declaration distpow-lint's ``metrics-registry`` rule verifies every
 * ``sched.mixed_hash_launches`` — batched launches whose slot set
   spans more than one hash model (per-model sub-batches inside one
   compiled program — sched/engine.py, docs/SERVING.md)
+* ``sched.lane_launches.<lane>`` — launch groups served per launch
+  lane (``pallas`` / ``mesh`` / ``xla`` — the sched/lanes.py planner;
+  a demoted group counts under the lane that actually served it)
 * ``sched.admission_rejected`` — Mine requests shed by the
   coordinator's bounded run queue (sched/admission.py)
 * ``sched.coalesced_requests`` — duplicate in-flight Mines attached as
@@ -189,6 +192,9 @@ leak from the trend detector exactly when it matters):
 * ``search.hashes_per_s``  — rolling backend throughput
 * ``sched.active_slots`` / ``sched.run_queue_depth`` — continuous-
   batching occupancy and bounded run-queue depth (sched/engine.py)
+* ``search.mesh_devices`` — device count of the most recently built
+  search mesh (parallel/mesh_search.py make_mesh — the mesh serving
+  lanes and backends all pass through it)
 * ``fleet.live_workers``   — coordinator-side count of non-draining
   members, static and elastic alike (distpow_tpu/fleet/membership.py)
 * ``proc.rss_bytes`` / ``proc.open_fds`` / ``proc.threads`` — per-node
@@ -259,6 +265,7 @@ KNOWN_COUNTERS = frozenset({
 KNOWN_COUNTER_PREFIXES = frozenset({
     "faults.injected.",
     "search.",  # backends/__init__.py count_exit: search.{cancelled,found}
+    "sched.lane_launches.",  # sched/engine.py per-lane launch counters
 })
 
 # The declared histogram registry — the same rule checks every
@@ -293,7 +300,7 @@ KNOWN_HISTOGRAM_PREFIXES = frozenset({
 KNOWN_GAUGES = frozenset({
     "worker.active_searches", "worker.mine_queue_depth",
     "worker.forward_queue_depth",
-    "search.hashes_per_s",
+    "search.hashes_per_s", "search.mesh_devices",
     "sched.active_slots", "sched.run_queue_depth",
     "fleet.live_workers",
     "proc.rss_bytes", "proc.open_fds", "proc.threads",

@@ -59,6 +59,7 @@ from ..ops.search_step import (
 from ..parallel.partition import contiguous_bounds
 from ..parallel.search import assemble_secret, effective_batch, width_segments
 from ..runtime.metrics import REGISTRY as metrics
+from .lanes import LanePlanner
 from ..runtime.spans import SPANS
 from ..runtime.telemetry import RECORDER
 from ..runtime.watchdog import FIRST_COMPILE_GRACE_S, WATCHDOG
@@ -149,13 +150,18 @@ class BatchingScheduler:
     ``fallback`` is the wrapped solo backend for shapes the packed step
     cannot express.  ``start=False`` defers the device loop (tests
     submit a deterministic slot set first, then :meth:`start`).
+
+    ``lane`` pins the launch-lane ranking (``WorkerConfig.SchedLane``):
+    "auto" lets the planner rank by hardware capability, "pallas" /
+    "mesh" / "xla" forces that lane first (sched/lanes.py).
     """
 
     def __init__(self, hash_model: str = "md5", batch_size: int = 1 << 20,
                  max_slots: int = 8, max_width: int = 8,
                  fallback: object = None,
                  start: bool = True,
-                 extra_models: Sequence[str] = ()) -> None:
+                 extra_models: Sequence[str] = (),
+                 lane: str = "auto") -> None:
         self.model = get_hash_model(hash_model)
         # models the packed step serves: the default plus any configured
         # extras (WorkerConfig.SchedHashModels).  Slots of different
@@ -172,6 +178,8 @@ class BatchingScheduler:
         self.max_slots = max(1, int(max_slots))
         self.max_width = max_width
         self.fallback = fallback
+        self.lane = lane
+        self.planner = LanePlanner(override=lane)
         self._cond = threading.Condition()
         self._pending: List[Slot] = []
         self._active: List[Slot] = []
@@ -315,11 +323,17 @@ class BatchingScheduler:
             )
         metrics.inc("sched.fallback_searches")
         from ..parallel.search import persistent_search
+        from .lanes import persistent_step_builder
 
+        tb_lo, tbc = contiguous_bounds(thread_bytes)
         res = persistent_search(
             nonce, difficulty, thread_bytes,
             model=model, batch_size=self.batch,
             cancel_check=cancel_check,
+            step_builder=persistent_step_builder(
+                nonce, difficulty, tb_lo, tbc, model,
+                override=self.lane,
+            ),
         )
         return None if res is None else res.secret
 
@@ -482,7 +496,7 @@ class BatchingScheduler:
         for s in group:
             by_key.setdefault(self._group_key(s), []).append(s)
         ordered = sorted(by_key.items(), key=lambda kv: kv[0])
-        gdefs, gops, gslots = [], [], []
+        gdefs, gops, gslots, gkeys = [], [], [], []
         for key, slots in ordered:
             model_name, n_blocks, tb_loc, chunk_locs = key
             n_pad = 1 << (len(slots) - 1).bit_length()
@@ -490,19 +504,59 @@ class BatchingScheduler:
             gdefs.append((model_name, n_blocks, tb_loc, chunk_locs, n_pad))
             gops.append(self._lane_ops(lanes))
             gslots.append(slots)
-        compile_key = (tuple(gdefs), self.batch)
+            # slot-membership key for the mesh lane's replicated operand
+            # cache: static rows only change when the lane stack does
+            gkeys.append(tuple(
+                (s.seq, s.vw, s.ntz, s.extra) for s in lanes
+            ))
+        # resolve each group's launch lane (sched/lanes.py): pallas /
+        # mesh groups dispatch their own steps; every xla group shares
+        # the classic slot/mixed dispatch.  Resolution is cached, so the
+        # per-launch planner cost is a dict hit per group.
+        resolved = [self.planner.resolve(gd, self.batch)
+                    for gd in gdefs]
+        lanes_used = [lane for lane, _ in resolved]
+        compile_key = (tuple(gdefs), tuple(lanes_used), self.batch)
         first_compile = compile_key not in self._compiled
-        if len(gdefs) == 1:
-            m, nb, tl, cl, n_pad = gdefs[0]
-            step = slot_search_step(m, nb, tl, cl, self.batch, n_pad)
 
-            def run():
-                return (jax.device_get(step(*gops[0])),)
-        else:
-            step = mixed_slot_search_step(tuple(gdefs), self.batch)
+        def run():
+            pending: List[Tuple[int, object]] = []
+            xla_idx = [i for i, lane in enumerate(lanes_used)
+                       if lane == "xla"]
+            for i, (lane, gstep) in enumerate(resolved):
+                if lane == "xla":
+                    continue
+                try:
+                    pending.append((i, gstep(gops[i], gkeys[i])))
+                except Exception as exc:
+                    # dispatch/compile failure: demote this lane for the
+                    # key and serve the group through xla in THIS launch
+                    # — no request ever observes the demotion
+                    self.planner.demote(gdefs[i], self.batch, lane, exc)
+                    lanes_used[i] = "xla"
+                    xla_idx.append(i)
+            if xla_idx:
+                xla_idx.sort()
+                if len(xla_idx) == 1:
+                    i = xla_idx[0]
+                    m, nb, tl, cl, n_pad = gdefs[i]
+                    s = slot_search_step(m, nb, tl, cl, self.batch, n_pad)
+                    pending.append((i, s(*gops[i])))
+                else:
+                    s = mixed_slot_search_step(
+                        tuple(gdefs[i] for i in xla_idx), self.batch
+                    )
+                    pending.extend(
+                        zip(xla_idx, s(tuple(gops[i] for i in xla_idx)))
+                    )
+            # one host sync for the whole launch regardless of how many
+            # lanes served it — the engine's single-sync discipline
+            fetched = jax.device_get([r for _, r in pending])
+            out: List[object] = [None] * len(gdefs)
+            for (i, _), v in zip(pending, fetched):
+                out[i] = v
+            return out
 
-            def run():
-                return jax.device_get(step(tuple(gops)))
         now = time.monotonic()
         with WATCHDOG.active():
             WATCHDOG.beat()
@@ -513,16 +567,28 @@ class BatchingScheduler:
             else:
                 res_groups = run()
 
+        # per-group launch coverage: specialized lanes may sweep more
+        # than self.batch candidates per slot per launch (the mesh lane
+        # covers n_dev x batch) — every cursor/fairness/throughput
+        # account below uses the group's own coverage
+        coverages = [
+            self.batch if lanes_used[i] == "xla"
+            else resolved[i][1].coverage
+            for i in range(len(gdefs))
+        ]
         metrics.observe("sched.batch_occupancy", len(group))
         metrics.inc("sched.launches")
+        for lane in lanes_used:
+            metrics.inc(f"sched.lane_launches.{lane}")
         if len({d[0] for d in gdefs}) > 1:
             metrics.inc("sched.mixed_hash_launches")
-        metrics.inc("search.hashes", len(group) * self.batch)
+        metrics.inc("search.hashes",
+                    sum(len(sl) * c for sl, c in zip(gslots, coverages)))
         finished: List[Tuple[Slot, Optional[bytes]]] = []
-        for slots, res in zip(gslots, res_groups):
+        for slots, res, cov in zip(gslots, res_groups, coverages):
             for i, s in enumerate(slots):
                 s.launches += 1
-                s.vtime += self.batch / s.weight
+                s.vtime += cov / s.weight
                 if s.first_launch_t is None:
                     s.first_launch_t = now
                     metrics.observe("sched.slot_wait_s",
@@ -547,7 +613,7 @@ class BatchingScheduler:
                     metrics.inc("search.found")
                     finished.append((s, secret))
                     continue
-                s.chunk0 += self.batch >> s.log_tbc
+                s.chunk0 += cov >> s.log_tbc
                 if s.chunk0 >= s.seg_hi and not self._advance_segment(s):
                     s.exhausted = True
                     finished.append((s, None))

@@ -74,7 +74,14 @@
 # acquisition is recorded into an order graph and the session FAILS on
 # any observed inversion — the dynamic twin of the static
 # lock-order-inversion rule (docs/CONCURRENCY.md) — ~2 min, CPU.
-# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--race-audit|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--soak-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]
+# `--mesh-smoke` runs the kernel-lane launch planner smoke
+# (scripts/mesh_smoke.py, docs/SERVING.md "Kernel-lane launch
+# planner"): 4 virtual CPU devices booted through the version-portable
+# compat shim, a scheduler solve and a solo persistent solve must both
+# ride the mesh lane and match the pure-python oracle byte-for-byte,
+# with sched.lane_launches.mesh and search.mesh_devices counting the
+# span — ~30 s, CPU.
+# Usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--race-audit|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--soak-smoke|--mesh-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -148,6 +155,13 @@ if [ "${1:-}" = "--soak-smoke" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "--mesh-smoke" ]; then
+  echo "=== mesh lane smoke (4 virtual CPU devices + lane planner parity) ==="
+  JAX_PLATFORMS=cpu python scripts/mesh_smoke.py
+  echo "=== mesh smoke OK ==="
+  exit 0
+fi
+
 if [ "${1:-}" = "--fleet-smoke" ]; then
   echo "=== fleet smoke (elastic join + weighted shards + hedge + drain) ==="
   JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
@@ -214,7 +228,7 @@ case "${1:-}" in
            exit 0 ;;
   "")     python -m pytest tests/ -q -m "not slow and not veryslow" ;;
   *)      echo "unknown argument: $1" >&2
-          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--race-audit|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]" >&2
+          echo "usage: scripts/ci.sh [--full|--nightly|--chaos|--lint|--race-audit|--bench-rehearsal|--sched-smoke|--wire-smoke|--serving-smoke|--slo-smoke|--soak-smoke|--mesh-smoke|--fleet-smoke|--forensics-smoke|--cluster-smoke|--ha-smoke]" >&2
           exit 2 ;;
 esac
 

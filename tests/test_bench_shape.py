@@ -782,3 +782,69 @@ def test_finalize_cache_ha_only_attaches_soak(bench):
     assert prov is None
     assert line["unit"] == "ratio"
     assert line["soak"] == SK
+
+
+# -- mesh-serving stage (ISSUE 20) --------------------------------------------
+
+MS = {
+    "ntz": 4, "batch": 1024, "solves": 24,
+    "arms": [
+        {"devices": 1, "requested_devices": 1, "ntz": 4, "batch": 1024,
+         "solves": 24, "wall_s": 0.683, "solves_per_s": 35.1,
+         "lane_launches": {"xla": 1111}},
+        {"devices": 4, "requested_devices": 4, "ntz": 4, "batch": 1024,
+         "solves": 24, "wall_s": 0.275, "solves_per_s": 87.2,
+         "lane_launches": {"mesh": 80, "xla": 24}},
+    ],
+    "speedup_x": 2.48, "ok": True,
+}
+
+
+def test_finalize_attaches_mesh_serving_row(bench):
+    """The mesh-serving stage rides both artifacts of a normal run,
+    like the other tunnel-independent rows."""
+    line, prov = bench.finalize_record(
+        {"serving": 9800.0e6}, LAST_FULL, 5.35e6, mesh_serving=MS
+    )
+    assert line["mesh_serving"] == MS
+    assert prov["mesh_serving"] == MS
+    assert line["unit"] == "MH/s"
+
+
+def test_finalize_mesh_serving_only_run(bench):
+    """bench.py --mesh-serving: the headline is the 4-vs-1-device
+    scheduler speedup and kernel provenance is NOT re-stamped."""
+    line, prov = bench.finalize_record({}, LAST_FULL, None, mesh_serving=MS)
+    assert prov is None
+    assert line["unit"] == "x"
+    assert line["value"] == 2.48
+    assert "mesh-serving" in line["metric"]
+    assert line["mesh_serving"] == MS
+
+
+def test_finalize_carries_forward_mesh_serving(bench):
+    lm = dict(LAST_FULL, mesh_serving=MS)
+    line, prov = bench.finalize_record({"serving": 9800.0e6}, lm, 5.35e6)
+    assert prov["mesh_serving"] == MS
+    assert "mesh_serving" not in line
+
+
+def test_finalize_control_plane_headline_attaches_mesh_serving(bench):
+    """Device-unreachable runs that measured both CPU stages: the
+    control-plane row stays the headline, mesh-serving rides along."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, control_plane=CP, mesh_serving=MS
+    )
+    assert prov is None
+    assert line["unit"] == "ms"
+    assert line["mesh_serving"] == MS
+
+
+def test_finalize_soak_only_attaches_mesh_serving(bench):
+    """A soak-headline run still carries the mesh-serving dict."""
+    line, prov = bench.finalize_record(
+        {}, LAST_FULL, None, soak=SK, mesh_serving=MS
+    )
+    assert prov is None
+    assert line["unit"] == "%"
+    assert line["mesh_serving"] == MS

@@ -347,6 +347,10 @@ def test_worker_compilation_cache_dir(tmp_path):
         # CPU-mesh compiles are faster than the production 0.5s
         # persistence threshold; persist everything for the assertion
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # in-suite, earlier tests may have already compiled (and
+        # lru-cached) every program this solve needs — the persistent
+        # cache only writes on a FRESH compile, so force one
+        jax.clear_caches()
         client = s.new_client("client1")
         res = mine_and_wait(client, b"\x5a\x5b", 2)
         assert puzzle.check_secret(res.nonce, res.secret, 2)
